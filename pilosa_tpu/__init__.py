@@ -1,0 +1,28 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch framework with the capabilities of Pilosa (the Go reference
+lives at /root/reference): a distributed bitmap index with the PQL query
+language, set/int(BSI)/time/mutex/bool fields, time-quantum views, TopN
+ranked caches, key translation, row/column attributes, replication and
+cluster membership — redesigned for TPU:
+
+* fragments are dense HBM-resident bitmap tensors (``uint32[rows, words]``)
+  instead of mmap'd roaring bitmaps; roaring survives only as the
+  storage/interchange codec,
+* the per-container op matrix (reference roaring/roaring.go:3078-4414)
+  collapses to vectorized AND/OR/XOR/ANDNOT + popcount XLA/Pallas kernels,
+* the executor compiles PQL ASTs to jitted XLA computations instead of Go
+  loops, and cross-shard map-reduce (reference executor.go:2454-2611) runs
+  as shard_map over a ``jax.sharding.Mesh`` with ICI collectives instead of
+  HTTP/protobuf fan-out.
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+
+__all__ = [
+    "SHARD_WIDTH",
+    "SHARD_WIDTH_EXP",
+    "__version__",
+]

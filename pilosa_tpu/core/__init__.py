@@ -1,0 +1,2 @@
+"""Data-model hierarchy: holder -> index -> field -> view -> fragment
+(reference: holder.go, index.go, field.go, view.go, fragment.go)."""

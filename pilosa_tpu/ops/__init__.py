@@ -1,0 +1,2 @@
+"""Device-side bitmap kernels: the TPU replacement for the reference's
+roaring container op matrix (reference: roaring/roaring.go:3078-4414)."""

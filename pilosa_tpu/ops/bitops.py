@@ -1,0 +1,170 @@
+"""Core bitmap word operations.
+
+The reference implements a per-container-type op matrix (array/bitmap/run ×
+intersect/union/difference/xor, reference roaring/roaring.go:3078-4414 and
+popcount :5057). On TPU every fragment row is a dense little-endian word
+vector ``uint32[SHARD_WORDS]``, so the whole matrix collapses to vectorized
+bitwise ops + ``lax.population_count``, which XLA fuses and tiles onto the
+VPU. Host-side helpers convert between column-id lists and packed words
+(numpy) for ingest/serialization.
+
+Bit addressing: column offset ``c`` within a shard lives at word ``c >> 5``,
+bit ``c & 31`` (little-endian within the word). With numpy little-endian
+``uint32 -> uint8`` views plus ``np.unpackbits(bitorder="little")`` this
+means flat bit index == column offset.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pilosa_tpu.shardwidth import SHARD_WORDS, WORD_BITS
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy) packing helpers — the ingest/serialization boundary.
+# ---------------------------------------------------------------------------
+
+
+def pack_columns(cols: np.ndarray, n_words: int = SHARD_WORDS) -> np.ndarray:
+    """Pack a sorted-or-not array of column offsets into uint32 words."""
+    words = np.zeros(n_words, dtype=np.uint32)
+    if len(cols) == 0:
+        return words
+    cols = np.asarray(cols, dtype=np.int64)
+    w = cols >> 5
+    b = (cols & 31).astype(np.uint32)
+    np.bitwise_or.at(words, w, np.uint32(1) << b)
+    return words
+
+
+def unpack_columns(words: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`pack_columns`: packed words -> sorted column offsets."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint64)
+
+
+def pack_positions(positions: np.ndarray, n_words: int) -> tuple[np.ndarray, np.ndarray]:
+    """Group absolute bit positions (row*SHARD_WIDTH + col) into (rows, words).
+
+    Returns ``(row_ids, words[len(row_ids), n_words])`` — one packed word
+    vector per distinct row. Used to turn op-log batches into device updates.
+    """
+    positions = np.asarray(positions, dtype=np.uint64)
+    shard_width = np.uint64(n_words * WORD_BITS)
+    rows = positions // shard_width
+    offs = positions % shard_width
+    row_ids, inverse = np.unique(rows, return_inverse=True)
+    words = np.zeros((len(row_ids), n_words), dtype=np.uint32)
+    w = (offs >> np.uint64(5)).astype(np.int64)
+    b = (offs & np.uint64(31)).astype(np.uint32)
+    np.bitwise_or.at(words, (inverse, w), np.uint32(1) << b)
+    return row_ids, words
+
+
+def popcount_host(words: np.ndarray) -> int:
+    """Host popcount over a word array (any shape)."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    return int(np.unpackbits(words.view(np.uint8)).sum())
+
+
+# ---------------------------------------------------------------------------
+# Device-side (jitted) kernels.
+# ---------------------------------------------------------------------------
+
+
+def popcount(words: jax.Array) -> jax.Array:
+    """Per-word population count (uint32 in, uint32 out)."""
+    return lax.population_count(words)
+
+
+@jax.jit
+def count_bits(words: jax.Array) -> jax.Array:
+    """Total set bits in a word tensor -> int32 scalar.
+
+    Safe while total <= 2^31; per-shard counts (<= 2^20 * rows bits) always
+    fit. Cross-shard totals are summed host-side in Python ints.
+    """
+    return jnp.sum(lax.population_count(words).astype(jnp.int32))
+
+
+@jax.jit
+def count_rows(bits: jax.Array) -> jax.Array:
+    """Row-wise popcount: ``uint32[..., rows, W] -> int32[..., rows]``.
+
+    The TPU replacement for the reference's per-row cache recount
+    (reference fragment.go:459-498).
+    """
+    return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def intersection_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    """popcount(a & b) without materializing the AND (XLA fuses the chain).
+
+    Replaces the per-type-pair ``intersectionCount*`` kernels
+    (reference roaring/roaring.go:568, 3078+).
+    """
+    return jnp.sum(lax.population_count(a & b).astype(jnp.int32))
+
+
+@jax.jit
+def union_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(a | b).astype(jnp.int32))
+
+
+@jax.jit
+def difference_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(a & ~b).astype(jnp.int32))
+
+
+@jax.jit
+def xor_count(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(a ^ b).astype(jnp.int32))
+
+
+def zero_row(n_words: int = SHARD_WORDS) -> jax.Array:
+    return jnp.zeros((n_words,), dtype=jnp.uint32)
+
+
+@jax.jit
+def shift_row(words: jax.Array, n: jax.Array | int = 1) -> jax.Array:
+    """Shift all bits toward higher column ids by ``n`` (reference
+    roaring.go:944 ``Shift``; only n=1 is used by PQL's Shift call, but the
+    kernel is general). Bits shifted past the shard edge are dropped —
+    cross-shard carry is handled by the executor like the reference's
+    per-shard Shift."""
+    n = jnp.asarray(n, dtype=jnp.uint32)
+    word_shift = (n // WORD_BITS).astype(jnp.int32)
+    bit_shift = n % WORD_BITS
+    # Shift whole words first (roll + mask), then bits with carry.
+    idx = jnp.arange(words.shape[-1], dtype=jnp.int32)
+    rolled = jnp.roll(words, word_shift, axis=-1)
+    rolled = jnp.where(idx >= word_shift, rolled, jnp.uint32(0))
+    hi = rolled << bit_shift
+    carry_src = jnp.roll(rolled, 1, axis=-1)
+    carry_src = jnp.where(idx >= 1, carry_src, jnp.uint32(0))
+    lo = jnp.where(
+        bit_shift > 0,
+        carry_src >> (jnp.uint32(WORD_BITS) - bit_shift),
+        jnp.uint32(0),
+    )
+    return hi | lo
+
+
+def range_mask(start: int, stop: int, n_words: int = SHARD_WORDS) -> np.ndarray:
+    """Host-built mask with bits [start, stop) set — used for flips/ranges
+    clipped to the shard (reference roaring.go:1727 ``Flip``)."""
+    words = np.zeros(n_words, dtype=np.uint32)
+    if stop <= start:
+        return words
+    first_w, last_w = start >> 5, (stop - 1) >> 5
+    words[first_w : last_w + 1] = np.uint32(0xFFFFFFFF)
+    words[first_w] &= np.uint32(0xFFFFFFFF) << np.uint32(start & 31)
+    if stop & 31:
+        words[last_w] &= np.uint32((1 << (stop & 31)) - 1)
+    return words

@@ -1,0 +1,241 @@
+"""Bit-sliced-index (BSI) kernels.
+
+The reference stores integer fields bit-sliced: row 0 = exists bit, row 1 =
+sign bit, rows 2..2+bitDepth = magnitude bit-planes (reference
+fragment.go:90-96 ``bsiExistsBit/bsiSignBit/bsiOffsetBit``), and runs range
+queries as sequential bit-plane scans (reference fragment.go:1271-1534) and
+Sum as popcount-per-plane place-value math (reference fragment.go:1130-1138).
+
+Here each kernel takes the magnitude planes as a dense ``uint32[depth, W]``
+tensor (LSB plane first) plus ``exists``/``sign``/``filter`` word vectors and
+evaluates the whole scan as an unrolled jitted loop over planes — ``depth``
+is a static Python int (<= 64), so each (op, depth) pair compiles once and
+the plane loop fuses into a handful of vector ops on the VPU.
+
+Values are stored as offset-from-base two's-complement-free sign/magnitude:
+stored = value - base; sign row holds stored < 0; planes hold abs(stored).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _bound_args(value_abs: int, depth: int):
+    """Encode a query bound's magnitude as traced kernel inputs: its low
+    ``depth`` bits as a uint32 vector plus an out-of-band flag for
+    ``value_abs >= 2^depth``. Keeping the bound traced (not static) means
+    each (op, depth, sign-variant) compiles exactly once no matter how many
+    distinct bounds a workload queries."""
+    bits = jnp.asarray([(value_abs >> k) & 1 for k in range(depth)], jnp.uint32)
+    oob = jnp.asarray(value_abs >= (1 << depth))
+    return bits, oob
+
+
+def _select(plane, bit):
+    """plane if bit else ~plane, with a traced bit."""
+    return jnp.where(bit == 1, plane, ~plane)
+
+
+@partial(jax.jit, static_argnames=("negative", "depth"))
+def _range_eq_kernel(planes, exists, sign, bits, oob, *, negative: bool, depth: int):
+    b = exists & (sign if negative else ~sign)
+    for k in range(depth):
+        b = b & _select(planes[k], bits[k])
+    # A bound outside the representable magnitude can equal nothing.
+    return jnp.where(oob, jnp.zeros_like(b), b)
+
+
+def range_eq(planes, exists, sign, *, value_abs: int, negative: bool, depth: int):
+    """Columns whose stored value == ±value_abs (reference fragment.go:1286)."""
+    bits, oob = _bound_args(value_abs, depth)
+    return _range_eq_kernel(
+        planes, exists, sign, bits, oob, negative=negative, depth=depth
+    )
+
+
+def _mag_lt(planes, candidates, bits, oob, depth: int, allow_eq: bool):
+    """Among candidates, magnitude < bound (or <= when allow_eq). A bound
+    >= 2^depth exceeds every stored magnitude, so all candidates match."""
+    lt = jnp.zeros_like(candidates)
+    eq = candidates
+    for k in reversed(range(depth)):
+        p = planes[k]
+        lt = lt | jnp.where(bits[k] == 1, eq & ~p, jnp.zeros_like(eq))
+        eq = eq & _select(p, bits[k])
+    out = (lt | eq) if allow_eq else lt
+    return jnp.where(oob, candidates, out)
+
+
+def _mag_gt(planes, candidates, bits, oob, depth: int, allow_eq: bool):
+    """Among candidates, magnitude > bound (or >= when allow_eq). A bound
+    >= 2^depth exceeds every stored magnitude, so nothing matches."""
+    gt = jnp.zeros_like(candidates)
+    eq = candidates
+    for k in reversed(range(depth)):
+        p = planes[k]
+        gt = gt | jnp.where(bits[k] == 1, jnp.zeros_like(eq), eq & p)
+        eq = eq & _select(p, bits[k])
+    out = (gt | eq) if allow_eq else gt
+    return jnp.where(oob, jnp.zeros_like(out), out)
+
+
+@partial(jax.jit, static_argnames=("negative", "depth", "allow_eq"))
+def _range_lt_kernel(planes, exists, sign, bits, oob, *, negative, depth, allow_eq):
+    neg = exists & sign
+    nonneg = exists & ~sign
+    if not negative:
+        return neg | _mag_lt(planes, nonneg, bits, oob, depth, allow_eq)
+    return _mag_gt(planes, neg, bits, oob, depth, allow_eq)
+
+
+def range_lt(planes, exists, sign, *, value: int, depth: int, allow_eq: bool):
+    """Columns with stored value < value (<= when allow_eq).
+
+    Mirrors the sign-split logic of the reference's rangeLT
+    (fragment.go:1378-1445): for a non-negative bound all negatives match
+    plus non-negatives with small-enough magnitude; for a negative bound
+    only negatives with large-enough magnitude match.
+    """
+    bits, oob = _bound_args(abs(value), depth)
+    return _range_lt_kernel(
+        planes, exists, sign, bits, oob,
+        negative=value < 0, depth=depth, allow_eq=allow_eq,
+    )
+
+
+@partial(jax.jit, static_argnames=("negative", "depth", "allow_eq"))
+def _range_gt_kernel(planes, exists, sign, bits, oob, *, negative, depth, allow_eq):
+    neg = exists & sign
+    nonneg = exists & ~sign
+    if not negative:
+        return _mag_gt(planes, nonneg, bits, oob, depth, allow_eq)
+    return nonneg | _mag_lt(planes, neg, bits, oob, depth, allow_eq)
+
+
+def range_gt(planes, exists, sign, *, value: int, depth: int, allow_eq: bool):
+    """Columns with stored value > value (>= when allow_eq); reference
+    fragment.go:1447-1514."""
+    bits, oob = _bound_args(abs(value), depth)
+    return _range_gt_kernel(
+        planes, exists, sign, bits, oob,
+        negative=value < 0, depth=depth, allow_eq=allow_eq,
+    )
+
+
+def range_between(planes, exists, sign, *, lo: int, hi: int, depth: int):
+    """lo <= stored <= hi (reference fragment.go:1516-1534 rangeBetween)."""
+    a = range_gt(planes, exists, sign, value=lo, depth=depth, allow_eq=True)
+    b = range_lt(planes, exists, sign, value=hi, depth=depth, allow_eq=True)
+    return a & b
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def sum_count(planes, exists, sign, filter_words, *, depth: int):
+    """(sum of stored values, count) over filtered columns.
+
+    Place-value popcount per plane, positives minus negatives (reference
+    fragment.go:1109-1160). Returns float64-safe int64 math on host side by
+    keeping per-plane int32 popcounts; totals are combined in int64 here
+    (CPU) / via two int32 halves (TPU handles int64 emulation for scalars).
+    """
+    f = exists & filter_words
+    pos = f & ~sign
+    neg = f & sign
+    pos_counts = []
+    neg_counts = []
+    for k in range(depth):
+        p = planes[k]
+        pos_counts.append(jnp.sum(lax.population_count(p & pos).astype(jnp.int32)))
+        neg_counts.append(jnp.sum(lax.population_count(p & neg).astype(jnp.int32)))
+    count = jnp.sum(lax.population_count(f).astype(jnp.int32))
+    return (
+        jnp.stack(pos_counts) if depth else jnp.zeros((0,), jnp.int32),
+        jnp.stack(neg_counts) if depth else jnp.zeros((0,), jnp.int32),
+        count,
+    )
+
+
+def sum_host(planes, exists, sign, filter_words, *, depth: int) -> tuple[int, int]:
+    """Host wrapper: exact arbitrary-precision (sum, count) from the
+    per-plane device popcounts."""
+    pos_c, neg_c, count = sum_count(planes, exists, sign, filter_words, depth=depth)
+    pos_c = [int(x) for x in pos_c]
+    neg_c = [int(x) for x in neg_c]
+    total = sum(c << k for k, c in enumerate(pos_c)) - sum(
+        c << k for k, c in enumerate(neg_c)
+    )
+    return total, int(count)
+
+
+@partial(jax.jit, static_argnames=("depth", "maximal"))
+def extreme_mag(planes, candidates, *, depth: int, maximal: bool):
+    """(magnitude, surviving-candidate words) of the max (or min) magnitude
+    among candidate columns. Empty candidate set returns (0, zeros)."""
+    c = candidates
+    mag = jnp.zeros((), jnp.int32)
+    nonempty = jnp.any(candidates != 0)
+    for k in reversed(range(depth)):
+        p = planes[k]
+        hit = c & (p if maximal else ~p)
+        any_hit = jnp.any(hit != 0)
+        c = jnp.where(any_hit, hit, c)
+        bit_on = any_hit if maximal else ~any_hit
+        mag = mag + jnp.where(bit_on, 1 << k if (1 << k) < 2**31 else 0, 0).astype(mag.dtype)
+    return jnp.where(nonempty, mag, 0), c
+
+
+def min_max_host(planes, exists, sign, filter_words, *, depth: int, maximal: bool):
+    """Host wrapper for Min/Max (reference fragment.go:1152-1225 minUnsigned/
+    maxUnsigned + sign handling): returns (stored_value, count) or
+    (0, 0) when no column matches."""
+    f = jnp.asarray(exists) & jnp.asarray(filter_words)
+    neg = f & jnp.asarray(sign)
+    nonneg = f & ~jnp.asarray(sign)
+    has_neg = bool(jnp.any(neg != 0))
+    has_nonneg = bool(jnp.any(nonneg != 0))
+    if not has_neg and not has_nonneg:
+        return 0, 0
+    if maximal:
+        # Max: prefer non-negatives (largest magnitude); else negatives
+        # (smallest magnitude).
+        if has_nonneg:
+            mag, c = extreme_mag(planes, nonneg, depth=depth, maximal=True)
+            value = _exact_mag(planes, c, depth, int(mag))
+        else:
+            mag, c = extreme_mag(planes, neg, depth=depth, maximal=False)
+            value = -_exact_mag(planes, c, depth, int(mag))
+    else:
+        if has_neg:
+            mag, c = extreme_mag(planes, neg, depth=depth, maximal=True)
+            value = -_exact_mag(planes, c, depth, int(mag))
+        else:
+            mag, c = extreme_mag(planes, nonneg, depth=depth, maximal=False)
+            value = _exact_mag(planes, c, depth, int(mag))
+    count = int(jnp.sum(lax.population_count(c).astype(jnp.int32)))
+    return value, count
+
+
+def _exact_mag(planes, survivors, depth: int, approx: int) -> int:
+    """extreme_mag tracks magnitude in int32; for depth >= 31 recompute the
+    exact magnitude from one surviving column on the host."""
+    if depth < 31:
+        return approx
+    import numpy as np
+
+    surv = np.asarray(survivors)
+    idx = np.flatnonzero(np.unpackbits(surv.view(np.uint8), bitorder="little"))
+    if len(idx) == 0:
+        return 0
+    col = int(idx[0])
+    w, b = col >> 5, col & 31
+    pl = np.asarray(planes)
+    mag = 0
+    for k in range(depth):
+        if (int(pl[k, w]) >> b) & 1:
+            mag |= 1 << k
+    return mag

@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WORDS
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    cols = np.unique(rng.integers(0, SHARD_WIDTH, size=1000))
+    words = bitops.pack_columns(cols)
+    out = bitops.unpack_columns(words)
+    np.testing.assert_array_equal(out, cols.astype(np.uint64))
+
+
+def test_pack_empty():
+    words = bitops.pack_columns(np.array([], dtype=np.int64))
+    assert words.shape == (SHARD_WORDS,)
+    assert bitops.popcount_host(words) == 0
+    assert len(bitops.unpack_columns(words)) == 0
+
+
+def test_pack_boundaries():
+    cols = np.array([0, 31, 32, 63, SHARD_WIDTH - 1])
+    words = bitops.pack_columns(cols)
+    np.testing.assert_array_equal(bitops.unpack_columns(words), cols)
+    assert bitops.popcount_host(words) == 5
+
+
+def test_pack_positions_groups_rows():
+    # rows 0 and 3, various cols
+    pos = np.array(
+        [0 * SHARD_WIDTH + 5, 3 * SHARD_WIDTH + 9, 0 * SHARD_WIDTH + 7],
+        dtype=np.uint64,
+    )
+    rows, words = bitops.pack_positions(pos, SHARD_WORDS)
+    np.testing.assert_array_equal(rows, [0, 3])
+    np.testing.assert_array_equal(bitops.unpack_columns(words[0]), [5, 7])
+    np.testing.assert_array_equal(bitops.unpack_columns(words[1]), [9])
+
+
+def test_device_counts_match_host():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 2**32, size=SHARD_WORDS, dtype=np.uint32)
+    b = rng.integers(0, 2**32, size=SHARD_WORDS, dtype=np.uint32)
+    assert int(bitops.count_bits(a)) == bitops.popcount_host(a)
+    assert int(bitops.intersection_count(a, b)) == bitops.popcount_host(a & b)
+    assert int(bitops.union_count(a, b)) == bitops.popcount_host(a | b)
+    assert int(bitops.difference_count(a, b)) == bitops.popcount_host(a & ~b)
+    assert int(bitops.xor_count(a, b)) == bitops.popcount_host(a ^ b)
+
+
+def test_count_rows():
+    rng = np.random.default_rng(2)
+    bits = rng.integers(0, 2**32, size=(4, SHARD_WORDS), dtype=np.uint32)
+    got = np.asarray(bitops.count_rows(bits))
+    want = [bitops.popcount_host(bits[i]) for i in range(4)]
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n", [1, 5, 31, 32, 33, 64, 100])
+def test_shift_row(n):
+    cols = np.array([0, 1, 40, 1000, SHARD_WIDTH - 1])
+    words = bitops.pack_columns(cols)
+    shifted = np.asarray(bitops.shift_row(words, n))
+    want = cols + n
+    want = want[want < SHARD_WIDTH]
+    np.testing.assert_array_equal(bitops.unpack_columns(shifted), want)
+
+
+@pytest.mark.parametrize(
+    "start,stop",
+    [(0, 0), (0, 1), (0, 32), (5, 37), (31, 33), (0, SHARD_WIDTH), (100, 100), (63, 64)],
+)
+def test_range_mask(start, stop):
+    words = bitops.range_mask(start, stop)
+    want = np.arange(start, stop, dtype=np.uint64)
+    np.testing.assert_array_equal(bitops.unpack_columns(words), want)

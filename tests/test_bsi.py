@@ -1,0 +1,173 @@
+"""BSI kernel tests against numpy brute force (the reference validates the
+same semantics in fragment_internal_test.go BSI/range sections)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.ops import bitops, bsi
+
+DEPTH = 10
+
+
+def make_fragment(values: dict[int, int]) -> Fragment:
+    f = Fragment()
+    cols = np.array(list(values), dtype=np.int64)
+    vals = np.array([values[c] for c in cols], dtype=np.int64)
+    f.import_values(cols, vals, DEPTH)
+    return f
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    cols = np.unique(rng.integers(0, 4000, size=300))
+    vals = rng.integers(-500, 500, size=len(cols))
+    values = dict(zip(cols.tolist(), vals.tolist()))
+    frag = make_fragment(values)
+    planes, exists, sign = frag.bsi_tensors(DEPTH)
+    return values, planes, exists, sign
+
+
+def cols_of(words) -> set[int]:
+    return set(bitops.unpack_columns(np.asarray(words)).tolist())
+
+
+def test_range_eq(data):
+    values, planes, exists, sign = data
+    for target in [0, 7, -13, 499, list(values.values())[0]]:
+        got = cols_of(
+            bsi.range_eq(
+                planes,
+                exists,
+                sign,
+                value_abs=abs(target),
+                negative=target < 0,
+                depth=DEPTH,
+            )
+        )
+        want = {c for c, v in values.items() if v == target}
+        assert got == want, target
+
+
+@pytest.mark.parametrize("bound", [-501, -500, -99, -1, 0, 1, 37, 499, 500])
+@pytest.mark.parametrize("allow_eq", [False, True])
+def test_range_lt(data, bound, allow_eq):
+    values, planes, exists, sign = data
+    got = cols_of(
+        bsi.range_lt(planes, exists, sign, value=bound, depth=DEPTH, allow_eq=allow_eq)
+    )
+    want = {
+        c for c, v in values.items() if (v <= bound if allow_eq else v < bound)
+    }
+    assert got == want
+
+
+@pytest.mark.parametrize("bound", [-501, -500, -99, -1, 0, 1, 37, 499, 500])
+@pytest.mark.parametrize("allow_eq", [False, True])
+def test_range_gt(data, bound, allow_eq):
+    values, planes, exists, sign = data
+    got = cols_of(
+        bsi.range_gt(planes, exists, sign, value=bound, depth=DEPTH, allow_eq=allow_eq)
+    )
+    want = {
+        c for c, v in values.items() if (v >= bound if allow_eq else v > bound)
+    }
+    assert got == want
+
+
+@pytest.mark.parametrize("lo,hi", [(-100, 100), (0, 0), (-500, 499), (5, 4), (-3, 3)])
+def test_range_between(data, lo, hi):
+    values, planes, exists, sign = data
+    got = cols_of(bsi.range_between(planes, exists, sign, lo=lo, hi=hi, depth=DEPTH))
+    want = {c for c, v in values.items() if lo <= v <= hi}
+    assert got == want
+
+
+def test_sum(data):
+    values, planes, exists, sign = data
+    ones = np.full_like(np.asarray(exists), 0xFFFFFFFF)
+    total, count = bsi.sum_host(planes, exists, sign, ones, depth=DEPTH)
+    assert total == sum(values.values())
+    assert count == len(values)
+
+
+def test_sum_filtered(data):
+    values, planes, exists, sign = data
+    keep = [c for c in values if c % 2 == 0]
+    filt = bitops.pack_columns(np.array(keep), np.asarray(exists).shape[0])
+    total, count = bsi.sum_host(planes, exists, sign, filt, depth=DEPTH)
+    assert total == sum(values[c] for c in keep)
+    assert count == len(keep)
+
+
+def test_min_max(data):
+    values, planes, exists, sign = data
+    ones = np.full_like(np.asarray(exists), 0xFFFFFFFF)
+    vmax, cmax = bsi.min_max_host(planes, exists, sign, ones, depth=DEPTH, maximal=True)
+    vmin, cmin = bsi.min_max_host(planes, exists, sign, ones, depth=DEPTH, maximal=False)
+    vals = list(values.values())
+    assert vmax == max(vals)
+    assert cmax == vals.count(max(vals))
+    assert vmin == min(vals)
+    assert cmin == vals.count(min(vals))
+
+
+def test_min_max_all_negative():
+    values = {1: -5, 2: -3, 3: -5}
+    frag = make_fragment(values)
+    planes, exists, sign = frag.bsi_tensors(DEPTH)
+    ones = np.full_like(np.asarray(exists), 0xFFFFFFFF)
+    assert bsi.min_max_host(planes, exists, sign, ones, depth=DEPTH, maximal=True) == (-3, 1)
+    assert bsi.min_max_host(planes, exists, sign, ones, depth=DEPTH, maximal=False) == (-5, 2)
+
+
+def test_min_max_empty():
+    frag = Fragment()
+    planes, exists, sign = frag.bsi_tensors(DEPTH)
+    ones = np.full_like(np.asarray(exists), 0xFFFFFFFF)
+    assert bsi.min_max_host(planes, exists, sign, ones, depth=DEPTH, maximal=True) == (0, 0)
+
+
+@pytest.mark.parametrize("bound", [1 << DEPTH, (1 << DEPTH) + 5, -(1 << DEPTH), -(1 << DEPTH) - 5, 1 << 40])
+def test_range_out_of_depth_bounds(data, bound):
+    # Bounds whose magnitude exceeds 2^depth must not alias mod 2^depth
+    # (regression: reference handles this in rangeLTUnsigned).
+    values, planes, exists, sign = data
+    for allow_eq in (False, True):
+        got = cols_of(
+            bsi.range_lt(planes, exists, sign, value=bound, depth=DEPTH, allow_eq=allow_eq)
+        )
+        want = {c for c, v in values.items() if (v <= bound if allow_eq else v < bound)}
+        assert got == want
+        got = cols_of(
+            bsi.range_gt(planes, exists, sign, value=bound, depth=DEPTH, allow_eq=allow_eq)
+        )
+        want = {c for c, v in values.items() if (v >= bound if allow_eq else v > bound)}
+        assert got == want
+    got = cols_of(
+        bsi.range_eq(
+            planes, exists, sign, value_abs=abs(bound), negative=bound < 0, depth=DEPTH
+        )
+    )
+    assert got == set()
+
+
+def test_range_bound_does_not_recompile(data):
+    # The bound is a traced input: querying many distinct bounds must reuse
+    # one compiled kernel per (op, depth, sign, allow_eq).
+    values, planes, exists, sign = data
+    bsi.range_lt(planes, exists, sign, value=3, depth=DEPTH, allow_eq=False)
+    misses0 = bsi._range_lt_kernel._cache_size()
+    for bound in range(4, 40):
+        bsi.range_lt(planes, exists, sign, value=bound, depth=DEPTH, allow_eq=False)
+    assert bsi._range_lt_kernel._cache_size() == misses0
+
+
+def test_extreme_mag_empty_candidates(data):
+    values, planes, exists, sign = data
+    zeros = np.zeros_like(np.asarray(exists))
+    for maximal in (True, False):
+        mag, c = bsi.extreme_mag(planes, zeros, depth=DEPTH, maximal=maximal)
+        assert int(mag) == 0
+        assert not np.asarray(c).any()

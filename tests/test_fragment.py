@@ -1,0 +1,168 @@
+import numpy as np
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def test_set_clear_get():
+    f = Fragment("i", "f", "standard", 0)
+    assert f.set_bit(3, 100)
+    assert not f.set_bit(3, 100)  # already set
+    assert f.get_bit(3, 100)
+    assert not f.get_bit(3, 101)
+    assert f.clear_bit(3, 100)
+    assert not f.clear_bit(3, 100)
+    assert not f.get_bit(3, 100)
+
+
+def test_large_row_ids():
+    f = Fragment()
+    big = 2**40 + 7
+    assert f.set_bit(big, 5)
+    assert f.get_bit(big, 5)
+    np.testing.assert_array_equal(f.row_columns(big), [5])
+
+
+def test_row_device_and_missing():
+    f = Fragment()
+    f.set_bit(1, 10)
+    f.set_bit(1, 20)
+    row = np.asarray(f.row_device(1))
+    np.testing.assert_array_equal(bitops.unpack_columns(row), [10, 20])
+    missing = np.asarray(f.row_device(999))
+    assert missing.sum() == 0
+
+
+def test_dirty_sync_scatter_and_full():
+    f = Fragment()
+    for r in range(20):
+        f.set_bit(r, r)
+    _ = f.device_bits()
+    # small dirty set -> scatter path
+    f.set_bit(0, 50)
+    row = np.asarray(f.row_device(0))
+    np.testing.assert_array_equal(bitops.unpack_columns(row), [0, 50])
+    # large dirty set -> full upload path
+    for r in range(20):
+        f.set_bit(r, 60 + r)
+    assert f.get_bit(19, 79)
+    row = np.asarray(f.row_device(19))
+    np.testing.assert_array_equal(bitops.unpack_columns(row), [19, 79])
+
+
+def test_import_bits_and_counts():
+    f = Fragment()
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 5, size=500)
+    cols = rng.integers(0, SHARD_WIDTH, size=500)
+    pairs = set(zip(rows.tolist(), cols.tolist()))
+    changed = f.import_bits(rows, cols)
+    assert changed == len(pairs)
+    assert f.total_count() == len(pairs)
+    # re-import changes nothing
+    assert f.import_bits(rows, cols) == 0
+    # clear half
+    assert f.import_bits(rows[:250], cols[:250], clear=True) == len(
+        set(zip(rows[:250].tolist(), cols[:250].tolist()))
+    )
+
+
+def test_row_counts():
+    f = Fragment()
+    f.import_bits(np.array([1, 1, 1, 2]), np.array([0, 1, 2, 9]))
+    ids, counts = f.row_counts()
+    d = dict(zip(ids, counts.tolist()))
+    assert d == {1: 3, 2: 1}
+
+
+def test_set_mutex():
+    f = Fragment()
+    f.set_bit(1, 7)
+    f.set_bit(2, 7)
+    f.set_bit(3, 8)
+    assert f.set_mutex(5, 7)
+    assert f.get_bit(5, 7)
+    assert not f.get_bit(1, 7)
+    assert not f.get_bit(2, 7)
+    assert f.get_bit(3, 8)  # other column untouched
+    assert not f.set_mutex(5, 7)  # no-op second time
+
+
+def test_set_row_clear_row():
+    f = Fragment()
+    words = bitops.pack_columns(np.array([1, 5, 9]), f.n_words)
+    assert f.set_row_words(4, words)
+    assert not f.set_row_words(4, words)
+    np.testing.assert_array_equal(f.row_columns(4), [1, 5, 9])
+    assert f.clear_row(4)
+    assert f.row_count(4) == 0
+
+
+def test_snapshot_roundtrip():
+    f = Fragment()
+    f.import_bits(np.array([0, 3, 3]), np.array([5, 6, 7]))
+    f.set_bit(9, 0)
+    f.clear_row(0)  # zero row should be dropped from snapshot
+    snap = f.to_host_rows()
+    assert set(snap) == {3, 9}
+    g = Fragment()
+    g.load_host_rows(snap)
+    assert g.total_count() == f.total_count()
+    np.testing.assert_array_equal(g.row_columns(3), [6, 7])
+    np.testing.assert_array_equal(g.row_columns(9), [0])
+
+
+class TestBSI:
+    def test_set_get_value(self):
+        f = Fragment()
+        assert f.set_value(10, 8, 42)
+        assert f.value(10, 8) == (42, True)
+        assert f.value(11, 8) == (0, False)
+        # negative stored value
+        f.set_value(11, 8, -17)
+        assert f.value(11, 8) == (-17, True)
+        # overwrite
+        f.set_value(10, 8, 3)
+        assert f.value(10, 8) == (3, True)
+
+    def test_clear_value(self):
+        f = Fragment()
+        f.set_value(5, 8, 99)
+        assert f.clear_value(5)
+        assert f.value(5, 8) == (0, False)
+        assert not f.clear_value(5)
+
+    def test_import_values(self):
+        f = Fragment()
+        cols = np.arange(50)
+        vals = np.arange(50) * 3 - 60
+        f.import_values(cols, vals, 9)
+        for c, v in zip(cols, vals):
+            assert f.value(int(c), 9) == (int(v), True)
+        # overwrite subset
+        f.import_values(cols[:10], np.full(10, 7), 9)
+        for c in cols[:10]:
+            assert f.value(int(c), 9) == (7, True)
+
+
+def test_import_bits_huge_row_ids():
+    # Regression: hashed row ids near 2^64 must not wrap in position math.
+    f = Fragment()
+    rows = np.array([2**50, 2**63 + 11, 2**50], dtype=np.uint64)
+    cols = np.array([5, 6, 7])
+    assert f.import_bits(rows, cols) == 3
+    assert f.get_bit(2**50, 5)
+    assert f.get_bit(2**50, 7)
+    assert f.get_bit(2**63 + 11, 6)
+    assert not f.get_bit(0, 5)
+
+
+def test_import_values_duplicate_cols_last_wins():
+    f = Fragment()
+    f.import_values(np.array([5, 5]), np.array([1, 2]), 4)
+    assert f.value(5, 4) == (2, True)
+    f.import_values(np.array([7, 7]), np.array([-1, 1]), 4)
+    assert f.value(7, 4) == (1, True)
+    f.import_values(np.array([7, 7]), np.array([1, -1]), 4)
+    assert f.value(7, 4) == (-1, True)
