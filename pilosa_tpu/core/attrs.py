@@ -1,0 +1,88 @@
+"""Row/column attribute stores (reference: attr.go, boltdb/attrstore.go).
+
+Arbitrary key/value metadata attached to row ids (per field) and column ids
+(per index). The reference backs this with BoltDB + an LRU cache; here a
+thread-safe dict with 100-id blocks + checksums for the anti-entropy diff
+protocol (reference attr.go:81-120 AttrBlock/attrBlocks.Diff). Persistence
+is JSON via the storage layer — attrs are never on the device data path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any
+
+# reference attr.go:29 attrBlockSize.
+ATTR_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._attrs: dict[int, dict[str, Any]] = {}
+
+    def attrs(self, id_: int) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._attrs.get(id_, {}))
+
+    def set_attrs(self, id_: int, attrs: dict[str, Any]) -> None:
+        """Merge semantics: None deletes a key (reference attr.go
+        SetAttrs)."""
+        with self._lock:
+            cur = self._attrs.setdefault(id_, {})
+            for k, v in attrs.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            if not cur:
+                del self._attrs[id_]
+
+    def set_bulk_attrs(self, attrs_by_id: dict[int, dict[str, Any]]) -> None:
+        with self._lock:
+            for id_, attrs in attrs_by_id.items():
+                self.set_attrs(id_, attrs)
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._attrs)
+
+    # -- anti-entropy blocks (reference attr.go:81-120) ---------------------
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """(block_id, checksum) pairs over 100-id blocks."""
+        with self._lock:
+            by_block: dict[int, list[int]] = {}
+            for id_ in self._attrs:
+                by_block.setdefault(id_ // ATTR_BLOCK_SIZE, []).append(id_)
+            out = []
+            for block_id in sorted(by_block):
+                h = hashlib.blake2b(digest_size=16)
+                for id_ in sorted(by_block[block_id]):
+                    h.update(
+                        json.dumps(
+                            [id_, self._attrs[id_]], sort_keys=True
+                        ).encode()
+                    )
+                out.append((block_id, h.digest()))
+            return out
+
+    def block_data(self, block_id: int) -> dict[int, dict[str, Any]]:
+        with self._lock:
+            lo = block_id * ATTR_BLOCK_SIZE
+            hi = lo + ATTR_BLOCK_SIZE
+            return {
+                id_: dict(a) for id_, a in self._attrs.items() if lo <= id_ < hi
+            }
+
+    # -- persistence --------------------------------------------------------
+
+    def to_dict(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {str(k): dict(v) for k, v in self._attrs.items()}
+
+    def load_dict(self, d: dict[str, dict[str, Any]]) -> None:
+        with self._lock:
+            self._attrs = {int(k): dict(v) for k, v in d.items()}

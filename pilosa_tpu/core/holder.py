@@ -1,0 +1,87 @@
+"""Holder: the root container of indexes (reference: holder.go:50).
+
+Memory-resident here; the storage layer (pilosa_tpu.storage) adds the
+on-disk directory tree + snapshot/op-log persistence the reference keeps
+under its data dir (reference holder.go:134-198 Open)."""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.shardwidth import SHARD_WORDS
+
+
+class Holder:
+    def __init__(self, n_words: int = SHARD_WORDS):
+        self.n_words = n_words
+        self._lock = threading.RLock()
+        self.indexes: dict[str, Index] = {}
+        self.on_create_index = None
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def create_index(
+        self, name: str, keys: bool = False, track_existence: bool = True
+    ) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                raise ValueError(f"index already exists: {name}")
+            idx = Index(name, keys=keys, track_existence=track_existence, n_words=self.n_words)
+            self.indexes[name] = idx
+            if self.on_create_index is not None:
+                self.on_create_index(idx)
+            return idx
+
+    def create_index_if_not_exists(
+        self, name: str, keys: bool = False, track_existence: bool = True
+    ) -> Index:
+        with self._lock:
+            idx = self.indexes.get(name)
+            if idx is None:
+                return self.create_index(name, keys, track_existence)
+            return idx
+
+    def delete_index(self, name: str) -> bool:
+        with self._lock:
+            return self.indexes.pop(name, None) is not None
+
+    def index_names(self) -> list[str]:
+        return sorted(self.indexes)
+
+    def field(self, index: str, field: str):
+        idx = self.index(index)
+        return idx.field(field) if idx is not None else None
+
+    def fragment(self, index: str, field: str, view: str, shard: int) -> Fragment | None:
+        """Direct fragment accessor (reference holder.go:496-502)."""
+        f = self.field(index, field)
+        if f is None:
+            return None
+        v = f.view(view)
+        return v.fragment(shard) if v is not None else None
+
+    def schema(self) -> list[dict]:
+        """reference holder.go:279-299 Schema."""
+        return [self.indexes[n].to_dict() for n in self.index_names()]
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        """Create all indexes/fields described (reference holder.go:318-345
+        applySchema)."""
+        from pilosa_tpu.core.field import FieldOptions
+
+        for idx_d in schema:
+            opts = idx_d.get("options", {})
+            idx = self.create_index_if_not_exists(
+                idx_d["name"],
+                keys=opts.get("keys", False),
+                track_existence=opts.get("trackExistence", True),
+            )
+            for f_d in idx_d.get("fields", []):
+                if f_d["name"].startswith("_"):
+                    continue
+                idx.create_field_if_not_exists(
+                    f_d["name"], FieldOptions.from_dict(f_d.get("options", {}))
+                )

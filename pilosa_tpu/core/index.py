@@ -1,0 +1,100 @@
+"""Index: a container of fields (reference: index.go).
+
+Per-index options: ``keys`` (string column keys) and ``trackExistence``
+(reference index.go:476-479). With trackExistence an internal ``_exists``
+field records every column ever set, powering ``Not()`` and existence
+queries (reference index.go:173-180 openExistenceField, holder.go:46
+existenceFieldName)."""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.field import Field, FieldOptions, validate_name
+from pilosa_tpu.shardwidth import SHARD_WORDS
+
+EXISTENCE_FIELD_NAME = "_exists"
+
+
+class Index:
+    def __init__(
+        self,
+        name: str,
+        keys: bool = False,
+        track_existence: bool = True,
+        n_words: int = SHARD_WORDS,
+    ):
+        validate_name(name)
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.n_words = n_words
+        self._lock = threading.RLock()
+        self.fields: dict[str, Field] = {}
+        # column attributes (reference index.go columnAttrs boltdb store)
+        self.column_attrs = AttrStore()
+        self.on_create_field = None
+        if track_existence:
+            self._create_existence_field()
+
+    def _create_existence_field(self) -> Field:
+        f = Field(self.name, EXISTENCE_FIELD_NAME, n_words=self.n_words)
+        self.fields[EXISTENCE_FIELD_NAME] = f
+        return f
+
+    def existence_field(self) -> Field | None:
+        return self.fields.get(EXISTENCE_FIELD_NAME)
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        """reference index.go:303-367 CreateField."""
+        with self._lock:
+            if name in self.fields:
+                raise ValueError(f"field already exists: {name}")
+            f = Field(self.name, name, options, self.n_words)
+            self.fields[name] = f
+            if self.on_create_field is not None:
+                self.on_create_field(self, f)
+            return f
+
+    def create_field_if_not_exists(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            f = self.fields.get(name)
+            if f is None:
+                return self.create_field(name, options)
+            return f
+
+    def delete_field(self, name: str) -> bool:
+        """reference index.go:430-453."""
+        with self._lock:
+            return self.fields.pop(name, None) is not None
+
+    def field_names(self, include_internal: bool = False) -> list[str]:
+        return sorted(
+            n for n in self.fields if include_internal or not n.startswith("_")
+        )
+
+    def available_shards(self) -> set[int]:
+        """Union over fields (reference index.go:244-259)."""
+        shards: set[int] = set()
+        for f in self.fields.values():
+            shards |= f.available_shards()
+        return shards
+
+    def add_column_existence(self, col: int) -> None:
+        """Mark a column as existing (reference executor.go:2098-2103)."""
+        ef = self.existence_field()
+        if ef is not None:
+            ef.set_bit(0, col)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "options": {"keys": self.keys, "trackExistence": self.track_existence},
+            "fields": [
+                self.fields[n].to_dict() for n in self.field_names()
+            ],
+        }

@@ -1,0 +1,92 @@
+"""String-key translation: key <-> uint64 id (reference: translate.go).
+
+The reference's ``TranslateStore`` is an mmap'd append-only log with
+in-memory hash indexes and primary/replica streaming (translate.go:55-66,
+91-97). Here the same interface with an in-memory implementation; the
+storage layer adds the append-only-log-backed store, and the cluster layer
+adds primary/replica semantics (non-primary stores are read-only and raise
+on new-key writes, reference translate.go:52 ErrTranslateStoreReadOnly).
+
+Ids are allocated sequentially from 1 (0 is never a valid translated id).
+Columns translate per index; rows per (index, field).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class TranslateStoreReadOnlyError(Exception):
+    pass
+
+
+class TranslateStore:
+    """In-memory bidirectional key map (reference inmem/translator.go:37)."""
+
+    def __init__(self, read_only: bool = False):
+        self._lock = threading.RLock()
+        self.read_only = read_only
+        # (index, field) -> key -> id; field "" means column keys.
+        self._ids: dict[tuple[str, str], dict[str, int]] = {}
+        self._keys: dict[tuple[str, str], list[str]] = {}
+
+    def _space(self, index: str, field: str):
+        ids = self._ids.setdefault((index, field), {})
+        keys = self._keys.setdefault((index, field), [])
+        return ids, keys
+
+    def translate_keys(self, index: str, field: str, keys: list[str], create: bool = True) -> list[int]:
+        """keys -> ids, allocating new ids as needed (reference
+        translate.go TranslateColumnsToUint64 / TranslateRowsToUint64)."""
+        with self._lock:
+            ids, key_list = self._space(index, field)
+            out = []
+            for k in keys:
+                id_ = ids.get(k)
+                if id_ is None:
+                    if not create:
+                        out.append(0)
+                        continue
+                    if self.read_only:
+                        raise TranslateStoreReadOnlyError(
+                            "translate store is read-only (replica)"
+                        )
+                    id_ = len(key_list) + 1
+                    ids[k] = id_
+                    key_list.append(k)
+                out.append(id_)
+            return out
+
+    def translate_ids(self, index: str, field: str, id_list: list[int]) -> list[str]:
+        """ids -> keys; unknown ids map to "" (reference
+        TranslateColumnToString)."""
+        with self._lock:
+            _, key_list = self._space(index, field)
+            return [
+                key_list[i - 1] if 1 <= i <= len(key_list) else "" for i in id_list
+            ]
+
+    def translate_key(self, index: str, field: str, key: str, create: bool = True) -> int:
+        return self.translate_keys(index, field, [key], create=create)[0]
+
+    def translate_id(self, index: str, field: str, id_: int) -> str:
+        return self.translate_ids(index, field, [id_])[0]
+
+    # -- persistence --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "|".join(k): list(v) for k, v in self._keys.items()
+            }
+
+    def load_dict(self, d: dict) -> None:
+        with self._lock:
+            self._ids.clear()
+            self._keys.clear()
+            for joined, key_list in d.items():
+                index, _, field = joined.partition("|")
+                self._keys[(index, field)] = list(key_list)
+                self._ids[(index, field)] = {
+                    k: i + 1 for i, k in enumerate(key_list)
+                }

@@ -1,0 +1,2 @@
+"""Query execution: PQL ASTs lowered to jitted XLA computations over
+fragment tensors (the TPU replacement for reference executor.go)."""

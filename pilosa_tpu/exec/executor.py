@@ -1,0 +1,985 @@
+"""PQL executor (reference: executor.go, 3.2k LoC).
+
+Recursive evaluation of the PQL AST over fragment tensors. Where the
+reference runs per-shard map-reduce with goroutine pools and HTTP fan-out
+(executor.go:2454-2611), this executor evaluates bitmap algebra directly on
+device arrays — per-shard segments combined with fused XLA bitwise kernels
+— and leaves multi-device fan-out to pilosa_tpu.parallel (shard_map over a
+mesh) and multi-host fan-out to the cluster layer.
+
+Dispatch mirrors the reference table (executor.go:277-342): Sum/Min/Max,
+Clear/ClearRow/Store, Count, Set, SetRowAttrs/SetColumnAttrs, TopN, Rows,
+GroupBy, Options, and the bitmap calls Row/Range/Difference/Intersect/
+Union/Xor/Not/Shift (executor.go:653-680)."""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pilosa_tpu import pql
+from pilosa_tpu.core import timequantum
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+    FALSE_ROW_ID,
+    TRUE_ROW_ID,
+    Field,
+)
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec.result import (
+    FieldRow,
+    GroupCount,
+    Pair,
+    Row,
+    RowIdentifiers,
+    ValCount,
+)
+from pilosa_tpu.ops import bitops, bsi
+from pilosa_tpu.pql.ast import Call, Condition
+
+# reference executor.go:66 defaultMinThreshold.
+DEFAULT_MIN_THRESHOLD = 1
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class IndexNotFoundError(ExecuteError):
+    pass
+
+
+class FieldNotFoundError(ExecuteError):
+    pass
+
+
+class Executor:
+    def __init__(self, holder: Holder, translator: TranslateStore | None = None):
+        self.holder = holder
+        self.translator = translator or TranslateStore()
+
+    # ------------------------------------------------------------------ API
+
+    def execute(
+        self,
+        index_name: str,
+        query: str | pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any]:
+        """reference executor.go:116 Execute: translate -> execute ->
+        attach attrs -> translate results."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise IndexNotFoundError(f"index not found: {index_name}")
+        q = pql.parse(query) if isinstance(query, str) else query
+        results = []
+        for call in q.calls:
+            call = call.clone()
+            self._translate_call(idx, call)
+            results.append(self._execute_call(idx, call, shards))
+        return [self._translate_result(idx, c, r) for c, r in zip(q.calls, results)]
+
+    # ------------------------------------------------------- key translation
+
+    def _field_of_call(self, idx: Index, call: Call) -> Field | None:
+        fname = call.args.get("_field") or call.field_arg()
+        if fname is None:
+            return None
+        return idx.field(fname)
+
+    def _translate_call(self, idx: Index, call: Call) -> None:
+        """keys -> ids in place. Mirrors the reference's per-call-name arg
+        dispatch (executor.go:2625-2712 translateCall): each call shape
+        names which args hold column keys vs row keys."""
+        name = call.name
+        if name in ("Set", "Clear", "Row", "Range", "SetColumnAttrs", "ClearRow"):
+            col_key = "_col"
+            field_name = call.field_arg()
+            row_key = field_name
+        elif name == "SetRowAttrs":
+            col_key = None
+            row_key = "_row"
+            field_name = call.args.get("_field")
+        elif name == "Rows":
+            field_name = call.args.get("_field")
+            row_key = "previous"
+            col_key = "column"
+        else:
+            col_key = "col"
+            field_name = call.args.get("field")
+            row_key = "row"
+
+        # Translate column key (reference executor.go:2648-2664).
+        if col_key is not None:
+            col = call.args.get(col_key)
+            if idx.keys:
+                if col is not None and not isinstance(col, str):
+                    raise ExecuteError(
+                        "column value must be a string when index 'keys' option enabled"
+                    )
+                if isinstance(col, str):
+                    call.args[col_key] = self.translator.translate_key(
+                        idx.name, "", col
+                    )
+            elif isinstance(col, str):
+                raise ExecuteError(
+                    "string 'col' value not allowed unless index 'keys' option enabled"
+                )
+
+        # Translate row key (reference executor.go:2666-2712).
+        if field_name:
+            field = idx.field(field_name)
+            if field is not None and row_key is not None:
+                v = call.args.get(row_key)
+                if field.field_type == FIELD_TYPE_BOOL and isinstance(v, bool):
+                    call.args[row_key] = TRUE_ROW_ID if v else FALSE_ROW_ID
+                elif field.keys:
+                    if v is not None and not isinstance(v, str):
+                        raise ExecuteError(
+                            "row value must be a string when field 'keys' option enabled"
+                        )
+                    if isinstance(v, str):
+                        call.args[row_key] = self.translator.translate_key(
+                            idx.name, field_name, v
+                        )
+                elif isinstance(v, str):
+                    raise ExecuteError(
+                        "string 'row' value not allowed unless field 'keys' option enabled"
+                    )
+
+        for child in call.children:
+            self._translate_call(idx, child)
+        filt = call.args.get("filter")
+        if isinstance(filt, Call):
+            self._translate_call(idx, filt)
+
+    def _translate_result(self, idx: Index, call: Call, result: Any) -> Any:
+        """ids -> keys on results (reference executor.go:2783-2907)."""
+        if isinstance(result, Row) and idx.keys:
+            result.keys = self.translator.translate_ids(
+                idx.name, "", [int(c) for c in result.columns()]
+            )
+        elif isinstance(result, list) and result and isinstance(result[0], Pair):
+            field = self._field_of_call(idx, call)
+            if field is not None and field.keys:
+                keys = self.translator.translate_ids(
+                    idx.name, field.name, [p.id for p in result]
+                )
+                for p, k in zip(result, keys):
+                    p.key = k
+        elif isinstance(result, Pair):
+            field = self._field_of_call(idx, call)
+            if field is not None and field.keys:
+                result.key = self.translator.translate_id(
+                    idx.name, field.name, result.id
+                )
+        elif isinstance(result, RowIdentifiers):
+            field = self._field_of_call(idx, call)
+            if field is not None and field.keys:
+                result.keys = self.translator.translate_ids(
+                    idx.name, field.name, result.rows
+                )
+        elif isinstance(result, list) and result and isinstance(result[0], GroupCount):
+            for gc in result:
+                for fr in gc.group:
+                    field = idx.field(fr.field)
+                    if field is not None and field.keys:
+                        fr.row_key = self.translator.translate_id(
+                            idx.name, fr.field, fr.row_id
+                        )
+        return result
+
+    # ------------------------------------------------------------- dispatch
+
+    def _shards_for(self, idx: Index, shards: list[int] | None) -> list[int]:
+        if shards is not None:
+            return sorted(shards)
+        return sorted(idx.available_shards())
+
+    def _execute_call(self, idx: Index, call: Call, shards: list[int] | None) -> Any:
+        name = call.name
+        if name == "Sum":
+            return self._execute_sum(idx, call, shards)
+        if name == "Min":
+            return self._execute_min_max(idx, call, shards, maximal=False)
+        if name == "Max":
+            return self._execute_min_max(idx, call, shards, maximal=True)
+        if name == "MinRow":
+            return self._execute_min_max_row(idx, call, shards, maximal=False)
+        if name == "MaxRow":
+            return self._execute_min_max_row(idx, call, shards, maximal=True)
+        if name == "Clear":
+            return self._execute_clear(idx, call)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, call, shards)
+        if name == "Store":
+            return self._execute_store(idx, call, shards)
+        if name == "Count":
+            return self._execute_count(idx, call, shards)
+        if name == "Set":
+            return self._execute_set(idx, call)
+        if name == "SetRowAttrs":
+            return self._execute_set_row_attrs(idx, call)
+        if name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(idx, call)
+        if name == "TopN":
+            return self._execute_topn(idx, call, shards)
+        if name == "Rows":
+            return self._execute_rows(idx, call, shards)
+        if name == "GroupBy":
+            return self._execute_groupby(idx, call, shards)
+        if name == "Options":
+            return self._execute_options(idx, call, shards)
+        # bitmap calls
+        return self._execute_bitmap_call(idx, call, shards)
+
+    # --------------------------------------------------------- bitmap calls
+
+    def _execute_bitmap_call(self, idx: Index, call: Call, shards: list[int] | None) -> Row:
+        """reference executor.go:653-680 executeBitmapCallShard + attr
+        attach (executor.go:235-275)."""
+        row = self._bitmap_call(idx, call, self._shards_for(idx, shards))
+        # attach row attrs for a plain Row(f=<id>) (reference
+        # executor.go:244-263)
+        if call.name in ("Row", "Range"):
+            fname = call.field_arg()
+            if fname is not None:
+                v = call.args.get(fname)
+                field = idx.field(fname)
+                if field is not None and isinstance(v, int) and not isinstance(v, bool):
+                    row.attrs = field.row_attrs.attrs(v)
+        return row
+
+    def _bitmap_call(self, idx: Index, call: Call, shards: list[int]) -> Row:
+        name = call.name
+        if name in ("Row", "Range"):
+            return self._execute_row(idx, call, shards)
+        if name == "Difference":
+            return self._combine(idx, call, shards, "difference")
+        if name == "Intersect":
+            return self._combine(idx, call, shards, "intersect")
+        if name == "Union":
+            return self._combine(idx, call, shards, "union")
+        if name == "Xor":
+            return self._combine(idx, call, shards, "xor")
+        if name == "Not":
+            return self._execute_not(idx, call, shards)
+        if name == "Shift":
+            return self._execute_shift(idx, call, shards)
+        raise ExecuteError(f"unknown call: {name}")
+
+    def _combine(self, idx: Index, call: Call, shards: list[int], op: str) -> Row:
+        if op == "intersect" and not call.children:
+            raise ExecuteError("empty Intersect query is currently not supported")
+        rows = [self._bitmap_call(idx, c, shards) for c in call.children]
+        if not rows:
+            return Row(n_words=idx.n_words)
+        out = rows[0]
+        for r in rows[1:]:
+            out = getattr(out, op)(r)
+        return out
+
+    def _execute_not(self, idx: Index, call: Call, shards: list[int]) -> Row:
+        """Not() via the _exists field (reference executor.go executeNot)."""
+        if not idx.track_existence:
+            raise ExecuteError(
+                "Not() query requires existence tracking to be enabled"
+            )
+        if len(call.children) != 1:
+            raise ExecuteError("Not() takes one argument")
+        ef = idx.existence_field()
+        exists = self._field_row(ef, 0, shards)
+        child = self._bitmap_call(idx, call.children[0], shards)
+        return exists.difference(child)
+
+    def _execute_shift(self, idx: Index, call: Call, shards: list[int]) -> Row:
+        if len(call.children) != 1:
+            raise ExecuteError("Shift() takes one argument")
+        n, ok = call.int_arg("n")
+        child = self._bitmap_call(idx, call.children[0], shards)
+        return child.shift(n if ok else 1)
+
+    def _field_row(self, field: Field | None, row_id: int, shards: list[int], view: str = VIEW_STANDARD) -> Row:
+        out = Row(n_words=self.holder.n_words)
+        if field is None:
+            return out
+        v = field.view(view)
+        if v is None:
+            return out
+        for shard in shards:
+            frag = v.fragment(shard)
+            if frag is not None:
+                out.segments[shard] = frag.row_device(row_id)
+        return out
+
+    def _execute_row(self, idx: Index, call: Call, shards: list[int]) -> Row:
+        """reference executor.go:1444 executeRowShard: plain row, BSI
+        condition, or time range."""
+        fname = call.field_arg()
+        if fname is None:
+            raise ExecuteError(f"{call.name}() requires a field argument")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        v = call.args.get(fname)
+        if isinstance(v, Condition):
+            return self._execute_bsi_condition(idx, field, v, shards)
+        if "from" in call.args or "to" in call.args:
+            return self._execute_time_range(idx, field, call, shards)
+        if not isinstance(v, int) or isinstance(v, bool):
+            raise ExecuteError(f"{call.name}() row argument must be an integer")
+        if field.is_bsi():
+            raise ExecuteError(
+                f"{call.name}() cannot read a plain row from int field {fname!r}"
+            )
+        return self._field_row(field, v, shards)
+
+    def _time_bounds(self, field: Field, from_arg, to_arg) -> tuple[datetime, datetime] | None:
+        """Resolve (start, end), clamping a missing bound to the field's
+        existing time views via minMaxViews/timeOfView (reference
+        executor.go:1376-1397) — never walking the open-ended calendar.
+        Returns None when a bound is missing and no time views exist."""
+        q = field.options.time_quantum
+        if not q:
+            raise ExecuteError(
+                f"field {field.name!r} has no time quantum for time range"
+            )
+        start = timequantum.parse_time(from_arg) if from_arg is not None else None
+        end = timequantum.parse_time(to_arg) if to_arg is not None else None
+        if start is None or end is None:
+            time_views = [
+                v for v in field.views if v.startswith(VIEW_STANDARD + "_")
+            ]
+            lo_v, hi_v = timequantum.min_max_views(time_views, q)
+            if start is None:
+                if not lo_v:
+                    return None
+                start = timequantum.time_of_view(lo_v, False)
+            if end is None:
+                if not hi_v:
+                    return None
+                end = timequantum.time_of_view(hi_v, True)
+        return start, end
+
+    def _execute_time_range(self, idx: Index, field: Field, call: Call, shards: list[int]) -> Row:
+        """Union of the minimal time-view cover (reference
+        executor.go:1515-1531 + time.go viewsByTimeRange)."""
+        fname = field.name
+        row_id = call.args.get(fname)
+        bounds = self._time_bounds(
+            field, call.args.get("from"), call.args.get("to")
+        )
+        out = Row(n_words=idx.n_words)
+        if bounds is None:
+            return out
+        views = timequantum.views_by_time_range(
+            VIEW_STANDARD, bounds[0], bounds[1], field.options.time_quantum
+        )
+        for vname in views:
+            out = out.union(self._field_row(field, row_id, shards, view=vname))
+        return out
+
+    def _execute_bsi_condition(self, idx: Index, field: Field, cond: Condition, shards: list[int]) -> Row:
+        """BSI range predicate -> bit-plane kernels (reference
+        executor.go:1536-1566 executeBSIGroupRangeShard +
+        fragment.go:1271-1534)."""
+        if not field.is_bsi():
+            raise ExecuteError(
+                f"range condition on non-int field {field.name!r}"
+            )
+        op = cond.op
+        if op == "!=" and cond.value is None:
+            # f != null -> not-null (reference frag.notNull)
+            return self._bsi_rows(field, shards, lambda pl, ex, sg: ex)
+        if op == "==" and cond.value is None:
+            raise ExecuteError("Range(): <field> == null is not supported")
+        depth = field.bit_depth
+        base = field.base
+
+        if op in ("<", "<=", ">", ">="):
+            bound = int(cond.value) - base
+            fn = bsi.range_lt if op in ("<", "<=") else bsi.range_gt
+            allow_eq = op in ("<=", ">=")
+            return self._bsi_rows(
+                field,
+                shards,
+                lambda pl, ex, sg: fn(
+                    pl, ex, sg, value=bound, depth=depth, allow_eq=allow_eq
+                ),
+            )
+        if op in ("==", "!="):
+            stored = int(cond.value) - base
+            eq = self._bsi_rows(
+                field,
+                shards,
+                lambda pl, ex, sg: bsi.range_eq(
+                    pl, ex, sg, value_abs=abs(stored), negative=stored < 0, depth=depth
+                ),
+            )
+            if op == "==":
+                return eq
+            notnull = self._bsi_rows(field, shards, lambda pl, ex, sg: ex)
+            return notnull.difference(eq)
+        if op == "><":
+            lo, hi = cond.int_pair()
+            return self._bsi_rows(
+                field,
+                shards,
+                lambda pl, ex, sg: bsi.range_between(
+                    pl, ex, sg, lo=lo - base, hi=hi - base, depth=depth
+                ),
+            )
+        if op in ("<x<", "<=x<", "<x<=", "<=x<="):
+            lo, hi = cond.int_pair()
+            lo_op, hi_op = op.split("x")
+            lo_incl = lo if lo_op == "<=" else lo + 1
+            hi_incl = hi if hi_op == "<=" else hi - 1
+            return self._bsi_rows(
+                field,
+                shards,
+                lambda pl, ex, sg: bsi.range_between(
+                    pl, ex, sg, lo=lo_incl - base, hi=hi_incl - base, depth=depth
+                ),
+            )
+        raise ExecuteError(f"unsupported condition op: {op}")
+
+    def _bsi_rows(self, field: Field, shards: list[int], kernel) -> Row:
+        out = Row(n_words=self.holder.n_words)
+        view = field.view(field.bsi_view_name())
+        if view is None:
+            return out
+        for shard in shards:
+            frag = view.fragment(shard)
+            if frag is None:
+                continue
+            planes, exists, sign = frag.bsi_tensors(field.bit_depth)
+            out.segments[shard] = kernel(planes, exists, sign)
+        return out
+
+    # ------------------------------------------------------------ aggregates
+
+    def _execute_count(self, idx: Index, call: Call, shards: list[int] | None) -> int:
+        if len(call.children) != 1:
+            raise ExecuteError("Count() takes one argument")
+        row = self._bitmap_call(idx, call.children[0], self._shards_for(idx, shards))
+        return row.count()
+
+    def _sum_filter(self, idx: Index, call: Call, shards: list[int]):
+        if len(call.children) > 1:
+            raise ExecuteError(f"{call.name}() only accepts a single bitmap input")
+        if call.children:
+            return self._bitmap_call(idx, call.children[0], shards)
+        return None
+
+    def _bsi_field(self, idx: Index, call: Call) -> Field:
+        fname, ok = call.string_arg("field")
+        if not ok:
+            fname = call.args.get("_field")
+        if not fname:
+            raise ExecuteError(f"{call.name}(): field required")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        return field
+
+    def _execute_sum(self, idx: Index, call: Call, shards: list[int] | None) -> ValCount:
+        """reference executor.go:409-442 + executeSumCountShard."""
+        shards = self._shards_for(idx, shards)
+        field = self._bsi_field(idx, call)
+        filt = self._sum_filter(idx, call, shards)
+        view = field.view(field.bsi_view_name())
+        total, count = 0, 0
+        if view is not None:
+            ones = np.full(field.n_words, 0xFFFFFFFF, dtype=np.uint32)
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                planes, exists, sign = frag.bsi_tensors(field.bit_depth)
+                fw = ones
+                if filt is not None:
+                    fw = filt.segments.get(shard)
+                    if fw is None:
+                        continue
+                s, c = bsi.sum_host(planes, exists, sign, fw, depth=field.bit_depth)
+                total += s
+                count += c
+        if count == 0:
+            return ValCount()
+        return ValCount(value=total + count * field.base, count=count)
+
+    def _execute_min_max(self, idx: Index, call: Call, shards: list[int] | None, maximal: bool) -> ValCount:
+        shards = self._shards_for(idx, shards)
+        field = self._bsi_field(idx, call)
+        filt = self._sum_filter(idx, call, shards)
+        view = field.view(field.bsi_view_name())
+        best: ValCount | None = None
+        if view is not None:
+            ones = np.full(field.n_words, 0xFFFFFFFF, dtype=np.uint32)
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                planes, exists, sign = frag.bsi_tensors(field.bit_depth)
+                fw = ones
+                if filt is not None:
+                    fw = filt.segments.get(shard)
+                    if fw is None:
+                        continue
+                value, count = bsi.min_max_host(
+                    planes, exists, sign, fw, depth=field.bit_depth, maximal=maximal
+                )
+                if count == 0:
+                    continue
+                value += field.base
+                if best is None or (value > best.value if maximal else value < best.value):
+                    best = ValCount(value=value, count=count)
+                elif value == best.value:
+                    best.count += count
+        return best or ValCount()
+
+    def _execute_min_max_row(self, idx: Index, call: Call, shards: list[int] | None, maximal: bool) -> Pair:
+        """MinRow/MaxRow: extreme existing row id (reference
+        executor.go:560-651)."""
+        shards = self._shards_for(idx, shards)
+        fname, ok = call.string_arg("field")
+        if not ok:
+            raise ExecuteError(f"{call.name}(): field required")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        view = field.view(VIEW_STANDARD)
+        best: Pair | None = None
+        if view is not None:
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                ids, counts = frag.row_counts()
+                for rid, cnt in zip(ids, counts.tolist()):
+                    if cnt == 0:
+                        continue
+                    if (
+                        best is None
+                        or (rid > best.id if maximal else rid < best.id)
+                    ):
+                        best = Pair(id=rid, count=cnt)
+                    elif rid == best.id:
+                        best.count += cnt
+        return best or Pair()
+
+    # ------------------------------------------------------------- mutations
+
+    def _execute_set(self, idx: Index, call: Call) -> bool:
+        """reference executor.go:2069 executeSet."""
+        col, ok = call.uint_arg("_col")
+        if not ok:
+            raise ExecuteError("Set() column argument 'col' required")
+        fname = call.field_arg()
+        if fname is None:
+            raise ExecuteError("Set() argument required: field")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        idx.add_column_existence(col)
+        if field.is_bsi():
+            value, ok = call.int_arg(fname)
+            if not ok:
+                raise ExecuteError("Set() row argument 'row' required")
+            return field.set_value(col, value)
+        row, ok = call.uint_arg(fname)
+        if not ok:
+            raise ExecuteError("Set() row argument 'row' required")
+        ts = call.args.get("_timestamp")
+        timestamp = timequantum.parse_time(ts) if ts is not None else None
+        return field.set_bit(row, col, timestamp)
+
+    def _execute_clear(self, idx: Index, call: Call) -> bool:
+        col, ok = call.uint_arg("_col")
+        if not ok:
+            raise ExecuteError("Clear() column argument required")
+        fname = call.field_arg()
+        if fname is None:
+            raise ExecuteError("Clear() argument required: field")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        if field.is_bsi():
+            # reference semantics: Clear on an int field clears nothing via
+            # the standard view; we clear the stored value when the arg
+            # matches the column's current value is NOT checked (v1.3
+            # behavior: ClearBit on bsi fields is a no-op through views).
+            return field.clear_value(col)
+        row, ok = call.uint_arg(fname)
+        if not ok:
+            raise ExecuteError("row=<row> argument required to Clear() call")
+        return field.clear_bit(row, col)
+
+    def _execute_clear_row(self, idx: Index, call: Call, shards: list[int] | None) -> bool:
+        """reference executor.go:1899-1997."""
+        fname = call.field_arg()
+        if fname is None:
+            raise ExecuteError("ClearRow() argument required: field")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        if field.field_type not in ("set", "time", "mutex", "bool"):
+            raise ExecuteError(
+                f"ClearRow() is not supported on {field.field_type} fields"
+            )
+        row = call.args.get(fname)
+        if not isinstance(row, int) or isinstance(row, bool):
+            raise ExecuteError("ClearRow() requires a row argument")
+        changed = False
+        v = field.view(VIEW_STANDARD)
+        if v is not None:
+            for shard in self._shards_for(idx, shards):
+                frag = v.fragment(shard)
+                if frag is not None:
+                    changed |= frag.clear_row(row)
+        return changed
+
+    def _execute_store(self, idx: Index, call: Call, shards: list[int] | None) -> bool:
+        """Store(child, f=row): write child bitmap as a row (reference
+        executor.go:1999-2067 executeSetRow)."""
+        if len(call.children) != 1:
+            raise ExecuteError("Store() requires a source query")
+        fname = call.field_arg()
+        if fname is None:
+            raise ExecuteError("Store() argument required: field")
+        field = idx.field(fname)
+        if field is None:
+            # reference creates a set field on demand for Store
+            # (executor.go:2016-2023).
+            field = idx.create_field(fname)
+        row = call.args.get(fname)
+        if not isinstance(row, int) or isinstance(row, bool):
+            raise ExecuteError("Store() requires a row argument")
+        shards = self._shards_for(idx, shards)
+        child = self._bitmap_call(idx, call.children[0], shards)
+        view = field.create_view_if_not_exists(VIEW_STANDARD)
+        changed = False
+        for shard in shards:
+            seg = child.segments.get(shard)
+            words = (
+                np.zeros(field.n_words, dtype=np.uint32)
+                if seg is None
+                else np.asarray(seg)
+            )
+            frag = view.create_fragment_if_not_exists(shard)
+            changed |= frag.set_row_words(row, words)
+        return changed
+
+    def _execute_set_row_attrs(self, idx: Index, call: Call) -> None:
+        fname, ok = call.string_arg("_field")
+        field = idx.field(fname) if ok else None
+        if field is None:
+            raise FieldNotFoundError("SetRowAttrs() field not found")
+        row, ok = call.uint_arg("_row")
+        if not ok:
+            raise ExecuteError("SetRowAttrs() row required")
+        attrs = {
+            k: v for k, v in call.args.items() if k not in ("_field", "_row")
+        }
+        field.row_attrs.set_attrs(row, attrs)
+        return None
+
+    def _execute_set_column_attrs(self, idx: Index, call: Call) -> None:
+        col, ok = call.uint_arg("_col")
+        if not ok:
+            raise ExecuteError("SetColumnAttrs() column required")
+        attrs = {k: v for k, v in call.args.items() if k != "_col"}
+        idx.column_attrs.set_attrs(col, attrs)
+        return None
+
+    # ------------------------------------------------------------------ TopN
+
+    def _execute_topn(self, idx: Index, call: Call, shards: list[int] | None) -> list[Pair]:
+        """Exact TopN (reference executor.go:860-999 is two-phase because
+        per-shard caches are approximate; device row counts are exact, so a
+        single pass suffices and strictly dominates the reference's
+        accuracy)."""
+        shards = self._shards_for(idx, shards)
+        fname, ok = call.string_arg("_field")
+        if not ok:
+            raise ExecuteError("TopN() field required")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        if field.is_bsi():
+            raise ExecuteError(f"cannot compute TopN() on integer field: {fname!r}")
+        if field.options.cache_type == "none":
+            raise ExecuteError(f"cannot compute TopN(), field has no cache: {fname!r}")
+        n, _ = call.uint_arg("n")
+        ids_arg, has_ids = call.uint_slice_arg("ids")
+        threshold, has_threshold = call.uint_arg("threshold")
+        if not has_threshold or threshold == 0:
+            threshold = DEFAULT_MIN_THRESHOLD
+        tanimoto, has_tanimoto = call.uint_arg("tanimotoThreshold")
+        if has_tanimoto and tanimoto > 100:
+            raise ExecuteError("Tanimoto Threshold is from 1 to 100 only")
+        attr_name, _ = call.string_arg("attrName")
+        attr_values = call.args.get("attrValues")
+
+        src: Row | None = None
+        if len(call.children) == 1:
+            src = self._bitmap_call(idx, call.children[0], shards)
+        elif len(call.children) > 1:
+            raise ExecuteError("TopN() can only have one input bitmap")
+
+        view = field.view(VIEW_STANDARD)
+        counts: dict[int, int] = {}
+        src_count = src.count() if src is not None else 0
+        row_totals: dict[int, int] = {}
+        if view is not None:
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                ids, row_counts = frag.row_counts()
+                if src is not None:
+                    # Row totals accumulate over every shard the row exists
+                    # in, even where the src bitmap is empty — the tanimoto
+                    # denominator needs the full row cardinality.
+                    for rid, t in zip(ids, row_counts.tolist()):
+                        row_totals[rid] = row_totals.get(rid, 0) + t
+                    seg = src.segments.get(shard)
+                    if seg is None:
+                        continue
+                    inter = np.asarray(
+                        bitops.count_rows(frag.rows_device(ids) & seg[None, :])
+                    )
+                    for rid, c in zip(ids, inter.tolist()):
+                        if c:
+                            counts[rid] = counts.get(rid, 0) + c
+                else:
+                    for rid, c in zip(ids, row_counts.tolist()):
+                        if c:
+                            counts[rid] = counts.get(rid, 0) + c
+
+        if has_ids and ids_arg is not None:
+            counts = {r: counts.get(r, 0) for r in ids_arg}
+        if attr_name:
+            wanted = set()
+            if isinstance(attr_values, list):
+                wanted = {v for v in attr_values}
+            keep = {}
+            for rid, c in counts.items():
+                av = field.row_attrs.attrs(rid).get(attr_name)
+                if av is not None and (not wanted or av in wanted):
+                    keep[rid] = c
+            counts = keep
+        if has_tanimoto and src is not None:
+            keep = {}
+            for rid, c in counts.items():
+                denom = row_totals.get(rid, 0) + src_count - c
+                if denom > 0 and c * 100 >= tanimoto * denom:
+                    keep[rid] = c
+            counts = keep
+        pairs = [
+            Pair(id=rid, count=c)
+            for rid, c in counts.items()
+            if c >= threshold or has_ids
+        ]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        if n and not has_ids:
+            pairs = pairs[:n]
+        return pairs
+
+    # ------------------------------------------------------------------ Rows
+
+    def _rows_of_field(
+        self,
+        field: Field,
+        shards: list[int],
+        views: list[str] | None = None,
+    ) -> list[int]:
+        """Sorted distinct row ids with at least one bit (reference
+        fragment.go:2601-2712 rows())."""
+        ids: set[int] = set()
+        for vname in [VIEW_STANDARD] if views is None else views:
+            v = field.view(vname)
+            if v is None:
+                continue
+            for shard in shards:
+                frag = v.fragment(shard)
+                if frag is None:
+                    continue
+                rids, counts = frag.row_counts()
+                ids.update(r for r, c in zip(rids, counts.tolist()) if c > 0)
+        return sorted(ids)
+
+    def _execute_rows(self, idx: Index, call: Call, shards: list[int] | None) -> RowIdentifiers:
+        """reference executor.go:1277-1442 executeRows."""
+        shards = self._shards_for(idx, shards)
+        fname, ok = call.string_arg("_field")
+        if not ok:
+            raise ExecuteError("Rows() field required")
+        field = idx.field(fname)
+        if field is None:
+            raise FieldNotFoundError(f"field not found: {fname}")
+        views = self._rows_views(field, call)
+        ids = self._rows_of_field(field, shards, views)
+
+        col = call.args.get("column")
+        if col is not None:
+            col = self._maybe_translate_col(idx, col)
+            shard = col // (field.n_words * 32)
+            off = col % (field.n_words * 32)
+            kept = []
+            for vname in [VIEW_STANDARD] if views is None else views:
+                v = field.view(vname)
+                if v is None:
+                    continue
+                frag = v.fragment(shard)
+                if frag is None:
+                    continue
+                kept.extend(r for r in ids if frag.get_bit(r, off))
+            ids = sorted(set(kept))
+
+        prev, has_prev = call.uint_arg("previous")
+        if has_prev:
+            ids = [r for r in ids if r > prev]
+        limit, has_limit = call.uint_arg("limit")
+        if has_limit:
+            ids = ids[:limit]
+        return RowIdentifiers(rows=ids)
+
+    def _rows_views(self, field: Field, call: Call) -> list[str] | None:
+        """Time-bounded Rows: compute the view cover (reference
+        executor.go:1342-1402)."""
+        from_arg = call.args.get("from")
+        to_arg = call.args.get("to")
+        if from_arg is None and to_arg is None:
+            return None
+        bounds = self._time_bounds(field, from_arg, to_arg)
+        if bounds is None:
+            return []
+        return timequantum.views_by_time_range(
+            VIEW_STANDARD, bounds[0], bounds[1], field.options.time_quantum
+        )
+
+    def _maybe_translate_col(self, idx: Index, col) -> int:
+        if isinstance(col, str):
+            if not idx.keys:
+                raise ExecuteError("string column on unkeyed index")
+            return self.translator.translate_key(idx.name, "", col)
+        return int(col)
+
+    # --------------------------------------------------------------- GroupBy
+
+    def _execute_groupby(self, idx: Index, call: Call, shards: list[int] | None) -> list[GroupCount]:
+        """reference executor.go:1071-1275: nested cross-product of Rows()
+        children, each level intersected with the previous."""
+        shards = self._shards_for(idx, shards)
+        if not call.children:
+            raise ExecuteError("GroupBy requires at least one Rows() child")
+        for c in call.children:
+            if c.name != "Rows":
+                raise ExecuteError("GroupBy children must be Rows queries")
+        limit, has_limit = call.uint_arg("limit")
+        filt_call, has_filt = call.call_arg("filter")
+        previous, has_prev = call.uint_slice_arg("previous")
+        if has_prev and len(previous) != len(call.children):
+            raise ExecuteError(
+                "'previous' argument must have a value for each GroupBy field"
+            )
+
+        filt_row = (
+            self._bitmap_call(idx, filt_call, shards) if has_filt else None
+        )
+
+        levels = []
+        for c in call.children:
+            fname = c.args.get("_field")
+            field = idx.field(fname)
+            if field is None:
+                raise FieldNotFoundError(f"field not found: {fname}")
+            row_ids = self._execute_rows(idx, c, shards).rows
+            levels.append((fname, field, row_ids))
+
+        results: list[GroupCount] = []
+        use_limit = has_limit and limit > 0
+        # one device gather per (level, row), not per combination
+        row_cache: dict[tuple[int, int], Row] = {}
+
+        def level_row(level: int, rid: int) -> Row:
+            key = (level, rid)
+            if key not in row_cache:
+                row_cache[key] = self._field_row(levels[level][1], rid, shards)
+            return row_cache[key]
+
+        def done() -> bool:
+            return use_limit and len(results) >= limit
+
+        def recurse(level: int, acc: Row | None, group: list[FieldRow], on_bound: bool):
+            """Depth-first cross product in row order. ``on_bound`` tracks
+            whether the prefix equals the `previous` page bound, in which
+            case rows before the bound are skipped and the bound combo
+            itself is excluded (reference executor.go:3127-3156 paging)."""
+            if done():
+                return
+            fname, field, row_ids = levels[level]
+            is_last = level + 1 == len(levels)
+            for rid in row_ids:
+                if done():
+                    return
+                bound_here = False
+                if on_bound:
+                    b = previous[level]
+                    if rid < b:
+                        continue
+                    if rid == b:
+                        if is_last:
+                            continue  # strictly after the bound combo
+                        bound_here = True
+                row = level_row(level, rid)
+                cur = row if acc is None else acc.intersect(row)
+                g = group + [FieldRow(field=fname, row_id=rid)]
+                if not is_last:
+                    recurse(level + 1, cur, g, bound_here)
+                else:
+                    final = cur if filt_row is None else cur.intersect(filt_row)
+                    cnt = final.count()
+                    if cnt > 0:
+                        results.append(GroupCount(group=g, count=cnt))
+
+        recurse(0, None, [], has_prev)
+        return results
+
+    # --------------------------------------------------------------- Options
+
+    def _execute_options(self, idx: Index, call: Call, shards: list[int] | None) -> Any:
+        """reference executor.go:344-406 executeOptionsCall."""
+        if len(call.children) != 1:
+            raise ExecuteError("Options() requires exactly one child")
+        exclude_columns, _ = call.bool_arg("excludeColumns")
+        exclude_row_attrs, _ = call.bool_arg("excludeRowAttrs")
+        column_attrs, _ = call.bool_arg("columnAttrs")
+        shards_arg, has_shards = call.uint_slice_arg("shards")
+        if has_shards:
+            shards = shards_arg
+        result = self._execute_call(idx, call.children[0], shards)
+        if isinstance(result, Row):
+            if exclude_columns:
+                result.segments = {}
+            if exclude_row_attrs:
+                result.attrs = {}
+            if column_attrs:
+                result.attrs["columnattrs"] = [
+                    {"id": int(c), "attrs": idx.column_attrs.attrs(int(c))}
+                    for c in result.columns()
+                    if idx.column_attrs.attrs(int(c))
+                ]
+        return result

@@ -1,0 +1,542 @@
+"""Executor behavior spec — mirrors the scenarios of the reference's
+executor_test.go (4085 LoC): every PQL call, keyed indexes, existence/Not,
+GroupBy paging, BSI ranges, TopN variants, time ranges."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import ExecuteError, Executor
+from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture()
+def ex():
+    h = Holder()
+    h.create_index("i")
+    return Executor(h)
+
+
+def cols(row: Row) -> list[int]:
+    return [int(c) for c in row.columns()]
+
+
+class TestSetRowCount:
+    def test_set_and_row(self, ex):
+        ex.holder.index("i").create_field("f")
+        res = ex.execute("i", "Set(10, f=1)")
+        assert res == [True]
+        res = ex.execute("i", "Set(10, f=1)")  # second set: no change
+        assert res == [False]
+        ex.execute("i", f"Set({SHARD_WIDTH + 2}, f=1)")
+        row = ex.execute("i", "Row(f=1)")[0]
+        assert cols(row) == [10, SHARD_WIDTH + 2]
+
+    def test_count(self, ex):
+        ex.holder.index("i").create_field("f")
+        for c in [1, 2, 3, SHARD_WIDTH * 2 + 1]:
+            ex.execute("i", f"Set({c}, f=7)")
+        assert ex.execute("i", "Count(Row(f=7))") == [4]
+
+    def test_clear(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(10, f=1)")
+        assert ex.execute("i", "Clear(10, f=1)") == [True]
+        assert ex.execute("i", "Clear(10, f=1)") == [False]
+        assert ex.execute("i", "Count(Row(f=1))") == [0]
+
+    def test_missing_field_errors(self, ex):
+        with pytest.raises(ExecuteError):
+            ex.execute("i", "Set(10, nope=1)")
+        with pytest.raises(ExecuteError):
+            ex.execute("i", "Row(nope=1)")
+
+
+class TestBitmapAlgebra:
+    @pytest.fixture()
+    def populated(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.holder.index("i").create_field("g")
+        for c in [1, 2, 3, 100]:
+            ex.execute("i", f"Set({c}, f=1)")
+        for c in [2, 3, 4, SHARD_WIDTH + 1]:
+            ex.execute("i", f"Set({c}, g=2)")
+        return ex
+
+    def test_intersect(self, populated):
+        row = populated.execute("i", "Intersect(Row(f=1), Row(g=2))")[0]
+        assert cols(row) == [2, 3]
+
+    def test_union(self, populated):
+        row = populated.execute("i", "Union(Row(f=1), Row(g=2))")[0]
+        assert cols(row) == [1, 2, 3, 4, 100, SHARD_WIDTH + 1]
+
+    def test_difference(self, populated):
+        row = populated.execute("i", "Difference(Row(f=1), Row(g=2))")[0]
+        assert cols(row) == [1, 100]
+
+    def test_xor(self, populated):
+        row = populated.execute("i", "Xor(Row(f=1), Row(g=2))")[0]
+        assert cols(row) == [1, 4, 100, SHARD_WIDTH + 1]
+
+    def test_not(self, populated):
+        row = populated.execute("i", "Not(Row(f=1))")[0]
+        assert cols(row) == [4, SHARD_WIDTH + 1]
+
+    def test_not_requires_existence(self):
+        h = Holder()
+        h.create_index("noex", track_existence=False)
+        h.index("noex").create_field("f")
+        e = Executor(h)
+        e.execute("noex", "Set(1, f=1)")
+        with pytest.raises(ExecuteError):
+            e.execute("noex", "Not(Row(f=1))")
+
+    def test_empty_intersect_errors(self, populated):
+        with pytest.raises(ExecuteError):
+            populated.execute("i", "Intersect()")
+
+    def test_empty_union_ok(self, populated):
+        assert cols(populated.execute("i", "Union()")[0]) == []
+
+    def test_shift(self, populated):
+        row = populated.execute("i", "Shift(Row(f=1), n=2)")[0]
+        assert cols(row) == [3, 4, 5, 102]
+
+    def test_count_nested(self, populated):
+        assert populated.execute("i", "Count(Union(Row(f=1), Row(g=2)))") == [6]
+
+
+class TestBSI:
+    @pytest.fixture()
+    def ex_bsi(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("v", FieldOptions(field_type="int", min_=-1000, max_=1000))
+        idx.create_field("f")
+        vals = {1: 10, 2: -10, 3: 500, 4: 0, SHARD_WIDTH + 5: 7}
+        for c, v in vals.items():
+            ex.execute("i", f"Set({c}, v={v})")
+        self_vals = vals
+        ex.vals = self_vals
+        return ex
+
+    def test_set_value_and_conditions(self, ex_bsi):
+        assert cols(ex_bsi.execute("i", "Row(v > 5)")[0]) == [1, 3, SHARD_WIDTH + 5]
+        assert cols(ex_bsi.execute("i", "Row(v >= 10)")[0]) == [1, 3]
+        assert cols(ex_bsi.execute("i", "Row(v < 0)")[0]) == [2]
+        assert cols(ex_bsi.execute("i", "Row(v == 500)")[0]) == [3]
+        assert cols(ex_bsi.execute("i", "Row(v != 500)")[0]) == [1, 2, 4, SHARD_WIDTH + 5]
+        assert cols(ex_bsi.execute("i", "Row(v != null)")[0]) == [1, 2, 3, 4, SHARD_WIDTH + 5]
+        assert cols(ex_bsi.execute("i", "Row(-10 < v < 10)")[0]) == [4, SHARD_WIDTH + 5]
+        assert cols(ex_bsi.execute("i", "Row(-10 <= v <= 10)")[0]) == [1, 2, 4, SHARD_WIDTH + 5]
+        assert cols(ex_bsi.execute("i", "Row(v >< [0, 10])")[0]) == [1, 4, SHARD_WIDTH + 5]
+        # Range() works identically to Row() for conditions
+        assert cols(ex_bsi.execute("i", "Range(v > 5)")[0]) == [1, 3, SHARD_WIDTH + 5]
+
+    def test_sum(self, ex_bsi):
+        res = ex_bsi.execute("i", "Sum(field=v)")[0]
+        assert res == ValCount(value=507, count=5)
+
+    def test_sum_filtered(self, ex_bsi):
+        ex_bsi.execute("i", "Set(1, f=9)")
+        ex_bsi.execute("i", "Set(3, f=9)")
+        res = ex_bsi.execute("i", "Sum(Row(f=9), field=v)")[0]
+        assert res == ValCount(value=510, count=2)
+
+    def test_min_max(self, ex_bsi):
+        assert ex_bsi.execute("i", "Min(field=v)")[0] == ValCount(value=-10, count=1)
+        assert ex_bsi.execute("i", "Max(field=v)")[0] == ValCount(value=500, count=1)
+
+    def test_min_max_filtered(self, ex_bsi):
+        ex_bsi.execute("i", "Set(1, f=9)")
+        ex_bsi.execute("i", "Set(4, f=9)")
+        assert ex_bsi.execute("i", "Min(Row(f=9), field=v)")[0] == ValCount(value=0, count=1)
+        assert ex_bsi.execute("i", "Max(Row(f=9), field=v)")[0] == ValCount(value=10, count=1)
+
+    def test_sum_empty(self, ex_bsi):
+        ex_bsi.holder.index("i").create_field(
+            "w", FieldOptions(field_type="int", min_=0, max_=10)
+        )
+        assert ex_bsi.execute("i", "Sum(field=w)")[0] == ValCount()
+
+    def test_out_of_range_set_errors(self, ex_bsi):
+        with pytest.raises(ValueError):
+            ex_bsi.execute("i", "Set(9, v=5000)")
+
+    def test_base_offset_field(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("b", FieldOptions(field_type="int", min_=100, max_=200))
+        ex.execute("i", "Set(1, b=150)")
+        ex.execute("i", "Set(2, b=100)")
+        assert cols(ex.execute("i", "Row(b > 120)")[0]) == [1]
+        assert ex.execute("i", "Sum(field=b)")[0] == ValCount(value=250, count=2)
+        assert ex.execute("i", "Min(field=b)")[0] == ValCount(value=100, count=1)
+
+
+class TestTopN:
+    @pytest.fixture()
+    def ex_top(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.holder.index("i").create_field("other")
+        # row 1: 4 bits, row 2: 2 bits, row 3: 1 bit, across shards
+        for c in [0, 1, 2, SHARD_WIDTH + 1]:
+            ex.execute("i", f"Set({c}, f=1)")
+        for c in [0, 1]:
+            ex.execute("i", f"Set({c}, f=2)")
+        ex.execute("i", "Set(9, f=3)")
+        return ex
+
+    def test_basic(self, ex_top):
+        pairs = ex_top.execute("i", "TopN(f, n=2)")[0]
+        assert pairs == [Pair(id=1, count=4), Pair(id=2, count=2)]
+
+    def test_all(self, ex_top):
+        pairs = ex_top.execute("i", "TopN(f)")[0]
+        assert pairs == [
+            Pair(id=1, count=4),
+            Pair(id=2, count=2),
+            Pair(id=3, count=1),
+        ]
+
+    def test_with_src(self, ex_top):
+        ex_top.execute("i", "Set(0, other=10)")
+        ex_top.execute("i", "Set(9, other=10)")
+        pairs = ex_top.execute("i", "TopN(f, Row(other=10), n=5)")[0]
+        assert pairs == [
+            Pair(id=1, count=1),
+            Pair(id=2, count=1),
+            Pair(id=3, count=1),
+        ]
+
+    def test_ids_restrict(self, ex_top):
+        pairs = ex_top.execute("i", "TopN(f, ids=[2,3])")[0]
+        assert pairs == [Pair(id=2, count=2), Pair(id=3, count=1)]
+
+    def test_threshold(self, ex_top):
+        pairs = ex_top.execute("i", "TopN(f, threshold=2)")[0]
+        assert pairs == [Pair(id=1, count=4), Pair(id=2, count=2)]
+
+    def test_attr_filter(self, ex_top):
+        ex_top.execute("i", 'SetRowAttrs(f, 1, category="x")')
+        ex_top.execute("i", 'SetRowAttrs(f, 3, category="y")')
+        pairs = ex_top.execute("i", 'TopN(f, attrName="category", attrValues=["x"])')[0]
+        assert pairs == [Pair(id=1, count=4)]
+
+    def test_int_field_errors(self, ex_top):
+        ex_top.holder.index("i").create_field(
+            "v", FieldOptions(field_type="int", min_=0, max_=10)
+        )
+        with pytest.raises(ExecuteError):
+            ex_top.execute("i", "TopN(v)")
+
+    def test_cache_none_errors(self, ex_top):
+        ex_top.holder.index("i").create_field(
+            "nc", FieldOptions(cache_type="none")
+        )
+        with pytest.raises(ExecuteError):
+            ex_top.execute("i", "TopN(nc)")
+
+
+class TestRowsAndGroupBy:
+    @pytest.fixture()
+    def ex_rows(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("a")
+        idx.create_field("b")
+        # a rows: 0 {0,1,2}, 1 {1,2}, 2 {2, SW+1}
+        for c in [0, 1, 2]:
+            ex.execute("i", f"Set({c}, a=0)")
+        for c in [1, 2]:
+            ex.execute("i", f"Set({c}, a=1)")
+        ex.execute("i", "Set(2, a=2)")
+        ex.execute("i", f"Set({SHARD_WIDTH + 1}, a=2)")
+        # b rows: 0 {0,2}, 1 {1}
+        for c in [0, 2]:
+            ex.execute("i", f"Set({c}, b=0)")
+        ex.execute("i", "Set(1, b=1)")
+        return ex
+
+    def test_rows(self, ex_rows):
+        res = ex_rows.execute("i", "Rows(a)")[0]
+        assert res == RowIdentifiers(rows=[0, 1, 2])
+
+    def test_rows_previous_limit(self, ex_rows):
+        assert ex_rows.execute("i", "Rows(a, previous=0)")[0].rows == [1, 2]
+        assert ex_rows.execute("i", "Rows(a, limit=2)")[0].rows == [0, 1]
+
+    def test_rows_column(self, ex_rows):
+        assert ex_rows.execute("i", "Rows(a, column=1)")[0].rows == [0, 1]
+        assert ex_rows.execute("i", f"Rows(a, column={SHARD_WIDTH + 1})")[0].rows == [2]
+
+    def test_groupby_single(self, ex_rows):
+        res = ex_rows.execute("i", "GroupBy(Rows(a))")[0]
+        assert res == [
+            GroupCount(group=[_fr("a", 0)], count=3),
+            GroupCount(group=[_fr("a", 1)], count=2),
+            GroupCount(group=[_fr("a", 2)], count=2),
+        ]
+
+    def test_groupby_two_fields(self, ex_rows):
+        res = ex_rows.execute("i", "GroupBy(Rows(a), Rows(b))")[0]
+        assert res == [
+            GroupCount(group=[_fr("a", 0), _fr("b", 0)], count=2),
+            GroupCount(group=[_fr("a", 0), _fr("b", 1)], count=1),
+            GroupCount(group=[_fr("a", 1), _fr("b", 0)], count=1),
+            GroupCount(group=[_fr("a", 1), _fr("b", 1)], count=1),
+            GroupCount(group=[_fr("a", 2), _fr("b", 0)], count=1),
+        ]
+
+    def test_groupby_limit_and_previous(self, ex_rows):
+        res = ex_rows.execute("i", "GroupBy(Rows(a), Rows(b), limit=2)")[0]
+        assert len(res) == 2
+        res2 = ex_rows.execute("i", "GroupBy(Rows(a), Rows(b), previous=[0, 1], limit=2)")[0]
+        assert res2 == [
+            GroupCount(group=[_fr("a", 1), _fr("b", 0)], count=1),
+            GroupCount(group=[_fr("a", 1), _fr("b", 1)], count=1),
+        ]
+
+    def test_groupby_filter(self, ex_rows):
+        res = ex_rows.execute("i", "GroupBy(Rows(a), filter=Row(b=0))")[0]
+        assert res == [
+            GroupCount(group=[_fr("a", 0)], count=2),
+            GroupCount(group=[_fr("a", 1)], count=1),
+            GroupCount(group=[_fr("a", 2)], count=1),
+        ]
+
+
+def _fr(field, row):
+    from pilosa_tpu.exec.result import FieldRow
+
+    return FieldRow(field=field, row_id=row)
+
+
+class TestClearRowStore:
+    def test_clear_row(self, ex):
+        ex.holder.index("i").create_field("f")
+        for c in [1, SHARD_WIDTH + 1]:
+            ex.execute("i", f"Set({c}, f=1)")
+        assert ex.execute("i", "ClearRow(f=1)") == [True]
+        assert ex.execute("i", "Count(Row(f=1))") == [0]
+        assert ex.execute("i", "ClearRow(f=1)") == [False]
+
+    def test_store(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("f")
+        for c in [1, 2, SHARD_WIDTH + 3]:
+            ex.execute("i", f"Set({c}, f=1)")
+        assert ex.execute("i", "Store(Row(f=1), g=5)") == [True]
+        assert cols(ex.execute("i", "Row(g=5)")[0]) == [1, 2, SHARD_WIDTH + 3]
+        # overwrite with a different row
+        ex.execute("i", "Set(9, f=2)")
+        ex.execute("i", "Store(Row(f=2), g=5)")
+        assert cols(ex.execute("i", "Row(g=5)")[0]) == [9]
+
+
+class TestAttrs:
+    def test_row_attrs_attach(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=7)")
+        ex.execute("i", 'SetRowAttrs(f, 7, name="seven", rank=3)')
+        row = ex.execute("i", "Row(f=7)")[0]
+        assert row.attrs == {"name": "seven", "rank": 3}
+
+    def test_column_attrs_option(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=7)")
+        ex.execute("i", 'SetColumnAttrs(1, kind="x")')
+        row = ex.execute("i", "Options(Row(f=7), columnAttrs=true)")[0]
+        assert row.attrs["columnattrs"] == [{"id": 1, "attrs": {"kind": "x"}}]
+
+    def test_attr_delete_with_null(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", 'SetRowAttrs(f, 7, name="seven")')
+        ex.execute("i", "SetRowAttrs(f, 7, name=null)")
+        assert ex.holder.field("i", "f").row_attrs.attrs(7) == {}
+
+    def test_options_exclude_columns(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=7)")
+        row = ex.execute("i", "Options(Row(f=7), excludeColumns=true)")[0]
+        assert cols(row) == []
+
+
+class TestTimeFields:
+    @pytest.fixture()
+    def ex_time(self, ex):
+        ex.holder.index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="YMDH")
+        )
+        ex.execute("i", "Set(1, t=9, 2017-01-02T03:00)")
+        ex.execute("i", "Set(2, t=9, 2017-01-02T04:00)")
+        ex.execute("i", "Set(3, t=9, 2017-03-01T00:00)")
+        return ex
+
+    def test_standard_row_has_all(self, ex_time):
+        assert cols(ex_time.execute("i", "Row(t=9)")[0]) == [1, 2, 3]
+
+    def test_range_window(self, ex_time):
+        row = ex_time.execute(
+            "i", "Range(t=9, 2017-01-02T00:00, 2017-01-03T00:00)"
+        )[0]
+        assert cols(row) == [1, 2]
+        row = ex_time.execute(
+            "i", "Range(t=9, 2017-01-01T00:00, 2017-04-01T00:00)"
+        )[0]
+        assert cols(row) == [1, 2, 3]
+        row = ex_time.execute(
+            "i", "Range(t=9, 2017-01-02T04:00, 2017-01-02T05:00)"
+        )[0]
+        assert cols(row) == [2]
+
+    def test_clear_removes_from_views(self, ex_time):
+        ex_time.execute("i", "Clear(1, t=9)")
+        row = ex_time.execute(
+            "i", "Range(t=9, 2017-01-01T00:00, 2017-02-01T00:00)"
+        )[0]
+        assert cols(row) == [2]
+
+
+class TestKeys:
+    @pytest.fixture()
+    def ex_keys(self):
+        h = Holder()
+        h.create_index("ki", keys=True)
+        h.index("ki").create_field("f", FieldOptions(keys=True))
+        h.index("ki").create_field("plain")
+        return Executor(h)
+
+    def test_keyed_set_row(self, ex_keys):
+        ex_keys.execute("ki", 'Set("alpha", f="one")')
+        ex_keys.execute("ki", 'Set("beta", f="one")')
+        row = ex_keys.execute("ki", 'Row(f="one")')[0]
+        assert row.keys == ["alpha", "beta"]
+
+    def test_keyed_topn(self, ex_keys):
+        ex_keys.execute("ki", 'Set("alpha", f="one")')
+        ex_keys.execute("ki", 'Set("beta", f="one")')
+        ex_keys.execute("ki", 'Set("alpha", f="two")')
+        pairs = ex_keys.execute("ki", "TopN(f, n=2)")[0]
+        assert [(p.key, p.count) for p in pairs] == [("one", 2), ("two", 1)]
+
+    def test_unkeyed_field_in_keyed_index(self, ex_keys):
+        ex_keys.execute("ki", 'Set("alpha", plain=1)')
+        row = ex_keys.execute("ki", "Row(plain=1)")[0]
+        assert row.keys == ["alpha"]
+
+    def test_string_key_on_unkeyed_index_errors(self, ex):
+        ex.holder.index("i").create_field("f")
+        with pytest.raises(ExecuteError):
+            ex.execute("i", 'Set("alpha", f=1)')
+
+
+class TestBoolFields:
+    def test_bool_rows(self, ex):
+        ex.holder.index("i").create_field("b", FieldOptions(field_type="bool"))
+        ex.execute("i", "Set(1, b=true)")
+        ex.execute("i", "Set(2, b=false)")
+        assert cols(ex.execute("i", "Row(b=true)")[0]) == [1]
+        assert cols(ex.execute("i", "Row(b=false)")[0]) == [2]
+        # flipping a bool moves the column (bool is a 2-row mutex in
+        # reference semantics via executeSetBitField on bool fields)
+        ex.execute("i", "Set(1, b=false)")
+        assert cols(ex.execute("i", "Row(b=false)")[0]) == [1, 2]
+
+
+class TestMutexFields:
+    def test_mutex(self, ex):
+        ex.holder.index("i").create_field("m", FieldOptions(field_type="mutex"))
+        ex.execute("i", "Set(1, m=10)")
+        ex.execute("i", "Set(1, m=20)")
+        assert cols(ex.execute("i", "Row(m=10)")[0]) == []
+        assert cols(ex.execute("i", "Row(m=20)")[0]) == [1]
+
+
+class TestMinMaxRow:
+    def test_min_max_row(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=3)")
+        ex.execute("i", "Set(2, f=9)")
+        assert ex.execute("i", "MinRow(field=f)") == [Pair(id=3, count=1)]
+        assert ex.execute("i", "MaxRow(field=f)") == [Pair(id=9, count=1)]
+
+
+class TestMultipleCallsAndShardArg:
+    def test_multi_call_query(self, ex):
+        ex.holder.index("i").create_field("f")
+        res = ex.execute("i", "Set(1, f=1)Set(2, f=1)Count(Row(f=1))")
+        assert res == [True, True, 2]
+
+    def test_options_shards(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=1)")
+        ex.execute("i", f"Set({SHARD_WIDTH + 1}, f=1)")
+        ex.execute("i", f"Set({SHARD_WIDTH * 2 + 1}, f=1)")
+        res = ex.execute("i", "Options(Count(Row(f=1)), shards=[0, 2])")
+        assert res == [2]
+
+
+class TestReviewRegressions:
+    def test_time_range_day31_month_advance(self, ex):
+        # Jan 31 + 1mo must land in February, not March (reference addMonth
+        # clamping, time.go:183-189).
+        from pilosa_tpu.core import timequantum as tq
+        from datetime import datetime
+
+        got = tq.views_by_time_range(
+            "standard", datetime(2017, 1, 31), datetime(2017, 6, 1), "YM"
+        )
+        assert "standard_201702" in got
+
+    def test_bool_field_is_exclusive(self, ex):
+        ex.holder.index("i").create_field("b", FieldOptions(field_type="bool"))
+        ex.execute("i", "Set(1, b=true)")
+        ex.execute("i", "Set(1, b=false)")
+        assert cols(ex.execute("i", "Row(b=true)")[0]) == []
+        assert cols(ex.execute("i", "Row(b=false)")[0]) == [1]
+
+    def test_import_clear_with_timestamps_rejected(self, ex):
+        from datetime import datetime
+
+        f = ex.holder.index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="YMD")
+        )
+        with pytest.raises(ValueError):
+            f.import_bits([1], [2], timestamps=[datetime(2020, 1, 1)], clear=True)
+
+    def test_open_ended_time_range_clamps_to_views(self, ex):
+        ex.holder.index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="YMDH")
+        )
+        ex.execute("i", "Set(1, t=9, 2017-01-02T03:00)")
+        ex.execute("i", "Set(2, t=9, 2019-06-01T00:00)")
+        # only `from` given: must terminate fast and cover through max view
+        row = ex.execute("i", "Range(t=9, from=2018-01-01T00:00, to=2020-01-01T00:00)")[0]
+        assert cols(row) == [2]
+
+    def test_rows_open_ended_from(self, ex):
+        ex.holder.index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="H")
+        )
+        ex.execute("i", "Set(1, t=5, 2020-01-01T00:00)")
+        res = ex.execute("i", "Rows(t, from=2020-01-01T00:00)")[0]
+        assert res.rows == [5]
+        # no views at all on a fresh time field -> empty, instantly
+        ex.holder.index("i").create_field(
+            "t2", FieldOptions(field_type="time", time_quantum="H")
+        )
+        assert ex.execute("i", "Rows(t2, from=2020-01-01T00:00)")[0].rows == []
+
+    def test_tanimoto_counts_all_shards(self, ex):
+        # row 1 has bits in shard 0 and shard 1; src only in shard 0.
+        ex.holder.index("i").create_field("f")
+        ex.holder.index("i").create_field("s")
+        ex.execute("i", "Set(0, f=1)")
+        ex.execute("i", f"Set({SHARD_WIDTH + 1}, f=1)")
+        ex.execute("i", "Set(0, s=9)")
+        # tanimoto: c=1, row_total=2, src=1 -> denom=2 -> score 50
+        assert ex.execute("i", "TopN(f, Row(s=9), tanimotoThreshold=60)")[0] == []
+        assert ex.execute("i", "TopN(f, Row(s=9), tanimotoThreshold=50)")[0] == [
+            Pair(id=1, count=1)
+        ]

@@ -1,0 +1,216 @@
+"""Data-model tests: holder/index/field/view/time quantum."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_TIME, Field, FieldOptions, bit_depth_of
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class TestTimeQuantum:
+    def test_valid(self):
+        for q in ["Y", "YM", "YMD", "YMDH", "M", "MD", "MDH", "D", "DH", "H", ""]:
+            assert tq.valid_quantum(q)
+        for q in ["X", "YD", "HM", "YMDHX"]:
+            assert not tq.valid_quantum(q)
+
+    def test_views_by_time(self):
+        t = datetime(2017, 1, 2, 3)
+        assert tq.views_by_time("standard", t, "YMDH") == [
+            "standard_2017",
+            "standard_201701",
+            "standard_20170102",
+            "standard_2017010203",
+        ]
+
+    def test_views_by_time_range_ymdh(self):
+        # reference time_internal_test.go style: partial hours/days at edges
+        got = tq.views_by_time_range(
+            "std", datetime(2016, 12, 31, 22), datetime(2017, 1, 2, 2), "YMDH"
+        )
+        assert got == [
+            "std_2016123122",
+            "std_2016123123",
+            "std_20170101",
+            "std_2017010200",
+            "std_2017010201",
+        ]
+
+    def test_views_by_time_range_year_cover(self):
+        got = tq.views_by_time_range(
+            "std", datetime(2015, 1, 1), datetime(2017, 1, 1), "YMDH"
+        )
+        assert got == ["std_2015", "std_2016"]
+
+    def test_views_by_time_range_month_only(self):
+        got = tq.views_by_time_range(
+            "std", datetime(2017, 1, 15), datetime(2017, 3, 1), "YM"
+        )
+        # M is the smallest unit: the reference uses the (over-covering)
+        # full-January view for the partial leading month
+        # (time.go:157-173 walk-down with nextMonthGTE).
+        assert got == ["std_201701", "std_201702"]
+
+    def test_min_max_views(self):
+        views = ["std_2017", "std_201701", "std_20170102", "std_2016"]
+        lo, hi = tq.min_max_views(views, "YMD")
+        assert (lo, hi) == ("std_2016", "std_2017")
+
+    def test_time_of_view(self):
+        assert tq.time_of_view("std_2017", False) == datetime(2017, 1, 1)
+        assert tq.time_of_view("std_2017", True) == datetime(2018, 1, 1)
+        assert tq.time_of_view("std_201702", True) == datetime(2017, 3, 1)
+        assert tq.time_of_view("std_20170102", False) == datetime(2017, 1, 2)
+        assert tq.time_of_view("std_2017010203", True) == datetime(2017, 1, 2, 4)
+
+    def test_parse_time(self):
+        assert tq.parse_time("2017-01-02T03:04") == datetime(2017, 1, 2, 3, 4)
+        with pytest.raises(ValueError):
+            tq.parse_time("2017-01-02")
+
+
+class TestField:
+    def test_set_field_multi_shard(self):
+        f = Field("i", "f")
+        f.set_bit(1, 0)
+        f.set_bit(1, SHARD_WIDTH + 5)  # second shard
+        assert f.get_bit(1, SHARD_WIDTH + 5)
+        assert f.available_shards() == {0, 1}
+
+    def test_time_field_views(self):
+        f = Field("i", "t", FieldOptions(field_type=FIELD_TYPE_TIME, time_quantum="YMD"))
+        f.set_bit(1, 9, timestamp=datetime(2018, 2, 3))
+        assert sorted(f.views) == [
+            "standard",
+            "standard_2018",
+            "standard_201802",
+            "standard_20180203",
+        ]
+        # clear_bit removes from every view
+        assert f.clear_bit(1, 9)
+        for v in f.views.values():
+            assert not v.get_bit(1, 9)
+
+    def test_time_field_requires_quantum_for_ts(self):
+        f = Field("i", "s")
+        with pytest.raises(ValueError):
+            f.set_bit(1, 1, timestamp=datetime(2018, 1, 1))
+
+    def test_mutex_field(self):
+        f = Field("i", "m", FieldOptions(field_type=FIELD_TYPE_MUTEX))
+        f.set_bit(1, 10)
+        f.set_bit(2, 10)
+        assert not f.get_bit(1, 10)
+        assert f.get_bit(2, 10)
+
+    def test_bool_field(self):
+        f = Field("i", "b", FieldOptions(field_type=FIELD_TYPE_BOOL))
+        f.set_bit(1, 3)  # true
+        assert f.get_bit(1, 3)
+
+    def test_int_field_value(self):
+        f = Field("i", "v", FieldOptions(field_type=FIELD_TYPE_INT, min_=-100, max_=1000))
+        assert f.set_value(7, 250)
+        assert f.value(7) == (250, True)
+        assert f.value(8) == (0, False)
+        f.set_value(8, -100)
+        assert f.value(8) == (-100, True)
+        with pytest.raises(ValueError):
+            f.set_value(9, 2000)
+        with pytest.raises(ValueError):
+            f.set_value(9, -101)
+        assert f.clear_value(7)
+        assert f.value(7) == (0, False)
+
+    def test_int_field_base_positive_range(self):
+        # all-positive range uses base=min for minimal depth
+        f = Field("i", "v", FieldOptions(field_type=FIELD_TYPE_INT, min_=1000, max_=1010))
+        assert f.base == 1000
+        assert f.bit_depth == bit_depth_of(10)
+        f.set_value(1, 1005)
+        assert f.value(1) == (1005, True)
+
+    def test_int_field_bit_depth_grows(self):
+        f = Field("i", "v", FieldOptions(field_type=FIELD_TYPE_INT, min_=0, max_=2**40))
+        d0 = f.bit_depth
+        f.set_value(1, 3)
+        f.set_value(2, 2**33)
+        assert f.value(2) == (2**33, True)
+        assert f.value(1) == (3, True)
+        assert f.bit_depth <= d0  # depth covers declared range already
+
+    def test_import_values_multi_shard(self):
+        f = Field("i", "v", FieldOptions(field_type=FIELD_TYPE_INT, min_=-50, max_=50))
+        cols = np.array([1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3])
+        vals = np.array([-50, 0, 50])
+        f.import_values(cols, vals)
+        for c, v in zip(cols, vals):
+            assert f.value(int(c)) == (int(v), True)
+
+    def test_import_bits_with_timestamps(self):
+        f = Field("i", "t", FieldOptions(field_type=FIELD_TYPE_TIME, time_quantum="YM"))
+        f.import_bits([1, 2], [5, 6], timestamps=[datetime(2019, 5, 1), None])
+        assert f.get_bit(1, 5) and f.get_bit(2, 6)
+        assert "standard_201905" in f.views
+        assert f.views["standard_201905"].get_bit(1, 5)
+
+    def test_name_validation(self):
+        with pytest.raises(ValueError):
+            Field("i", "UpperCase")
+        with pytest.raises(ValueError):
+            Field("i", "9starts-with-digit")
+        Field("i", "ok_name-1")
+
+
+class TestHolderIndex:
+    def test_create_and_lookup(self):
+        h = Holder()
+        idx = h.create_index("myindex")
+        f = idx.create_field("myfield")
+        assert h.field("myindex", "myfield") is f
+        assert h.fragment("myindex", "myfield", "standard", 0) is None
+        f.set_bit(1, 1)
+        assert h.fragment("myindex", "myfield", "standard", 0) is not None
+
+    def test_existence_field(self):
+        h = Holder()
+        idx = h.create_index("i")
+        assert idx.existence_field() is not None
+        idx.add_column_existence(42)
+        assert idx.existence_field().get_bit(0, 42)
+        idx2 = h.create_index("noexist", track_existence=False)
+        assert idx2.existence_field() is None
+
+    def test_duplicate_index_field(self):
+        h = Holder()
+        idx = h.create_index("i")
+        with pytest.raises(ValueError):
+            h.create_index("i")
+        idx.create_field("f")
+        with pytest.raises(ValueError):
+            idx.create_field("f")
+        assert h.create_index_if_not_exists("i") is idx
+
+    def test_schema_roundtrip(self):
+        h = Holder()
+        idx = h.create_index("users", keys=True)
+        idx.create_field("likes", FieldOptions(field_type=FIELD_TYPE_TIME, time_quantum="YMD"))
+        idx.create_field("age", FieldOptions(field_type=FIELD_TYPE_INT, min_=0, max_=120))
+        schema = h.schema()
+        h2 = Holder()
+        h2.apply_schema(schema)
+        assert h2.index("users").keys
+        assert h2.field("users", "age").options.max == 120
+        assert h2.field("users", "likes").options.time_quantum == "YMD"
+        assert h2.schema() == schema
+
+    def test_field_names_hides_internal(self):
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        assert idx.field_names() == ["f"]
+        assert "_exists" in idx.field_names(include_internal=True)

@@ -1,0 +1,209 @@
+"""Parser tests — the PQL strings mirror the forms exercised across the
+reference's executor_test.go and pql tests."""
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.pql.ast import Call, Condition
+
+
+def one(src: str) -> Call:
+    q = pql.parse(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+def test_row():
+    c = one("Row(f=1)")
+    assert c.name == "Row" and c.args == {"f": 1}
+
+
+def test_row_string_key():
+    c = one('Row(f="ten")')
+    assert c.args == {"f": "ten"}
+    c = one("Row(f=bareword)")
+    assert c.args == {"f": "bareword"}
+
+
+def test_set_forms():
+    c = one("Set(10, f=1)")
+    assert c.name == "Set" and c.args == {"_col": 10, "f": 1}
+    c = one('Set("col-key", f="row-key")')
+    assert c.args == {"_col": "col-key", "f": "row-key"}
+    c = one("Set(10, f=1, 2017-01-01T00:00)")
+    assert c.args == {"_col": 10, "f": 1, "_timestamp": "2017-01-01T00:00"}
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    (inter,) = c.children
+    assert inter.name == "Intersect"
+    assert [ch.name for ch in inter.children] == ["Row", "Row"]
+    assert inter.children[0].args == {"a": 1}
+
+
+def test_union_empty_and_one():
+    assert one("Union()").children == []
+    assert len(one("Union(Row(f=1))").children) == 1
+
+
+def test_topn():
+    c = one("TopN(f)")
+    assert c.args == {"_field": "f"}
+    c = one("TopN(f, n=5)")
+    assert c.args == {"_field": "f", "n": 5}
+    c = one('TopN(f, Row(g=1), n=10, attrName="x", attrValues=["a","b"])')
+    assert c.args["_field"] == "f"
+    assert c.args["n"] == 10
+    assert c.args["attrName"] == "x"
+    assert c.args["attrValues"] == ["a", "b"]
+    assert len(c.children) == 1 and c.children[0].name == "Row"
+
+
+def test_rows():
+    c = one("Rows(f)")
+    assert c.args == {"_field": "f"}
+    c = one("Rows(f, previous=2, limit=10, column=3)")
+    assert c.args == {"_field": "f", "previous": 2, "limit": 10, "column": 3}
+
+
+def test_groupby():
+    c = one("GroupBy(Rows(a), Rows(b), limit=5, filter=Row(c=1))")
+    assert c.name == "GroupBy"
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 5
+    filt = c.args["filter"]
+    assert isinstance(filt, Call) and filt.name == "Row" and filt.args == {"c": 1}
+
+
+def test_conditions():
+    c = one("Range(f > 5)")
+    assert c.args["f"] == Condition(">", 5)
+    c = one("Range(f <= -5)")
+    assert c.args["f"] == Condition("<=", -5)
+    c = one("Range(f != null)")
+    assert c.args["f"] == Condition("!=", None)
+    c = one("Range(f == 1.5)")
+    assert c.args["f"] == Condition("==", 1.5)
+    c = one("Range(f >< [1, 10])")
+    assert c.args["f"] == Condition("><", [1, 10])
+
+
+def test_ternary_conditions():
+    c = one("Range(-10 < f < 20)")
+    assert c.args["f"] == Condition("<x<", [-10, 20])
+    c = one("Range(0 <= f < 9)")
+    assert c.args["f"] == Condition("<=x<", [0, 9])
+    c = one("Range(0 <= f <= 9)")
+    assert c.args["f"] == Condition("<=x<=", [0, 9])
+
+
+def test_range_time_form():
+    c = one("Range(f=2, 1999-12-31T00:00, 2002-01-01T03:00)")
+    assert c.args == {
+        "f": 2,
+        "from": "1999-12-31T00:00",
+        "to": "2002-01-01T03:00",
+    }
+    c = one("Range(f=2, from=1999-12-31T00:00, to=2002-01-01T03:00)")
+    assert c.args["from"] == "1999-12-31T00:00"
+    assert c.args["to"] == "2002-01-01T03:00"
+
+
+def test_set_row_attrs():
+    c = one('SetRowAttrs(f, 10, foo="bar", baz=123, active=true, x=null)')
+    assert c.args == {
+        "_field": "f",
+        "_row": 10,
+        "foo": "bar",
+        "baz": 123,
+        "active": True,
+        "x": None,
+    }
+    c = one('SetRowAttrs(f, "row-key", foo="bar")')
+    assert c.args["_row"] == "row-key"
+
+
+def test_set_column_attrs():
+    c = one('SetColumnAttrs(10, foo="bar", ratio=0.25)')
+    assert c.args == {"_col": 10, "foo": "bar", "ratio": 0.25}
+
+
+def test_clear_and_clearrow():
+    c = one("Clear(10, f=1)")
+    assert c.args == {"_col": 10, "f": 1}
+    c = one("ClearRow(f=1)")
+    assert c.name == "ClearRow" and c.args == {"f": 1}
+
+
+def test_store():
+    c = one("Store(Row(f=1), g=2)")
+    assert c.name == "Store"
+    assert c.children[0].name == "Row"
+    assert c.args == {"g": 2}
+
+
+def test_not_options():
+    c = one("Not(Row(f=1))")
+    assert c.name == "Not" and len(c.children) == 1
+    c = one("Options(Row(f=1), excludeColumns=true)")
+    assert c.args == {"excludeColumns": True}
+
+
+def test_multiple_calls():
+    q = pql.parse("Set(1, f=1)Set(2, f=1) Count(Row(f=1))")
+    assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+
+
+def test_whitespace_tolerance():
+    c = one("  Count(\n  Row( f = 1 )\n)  ")
+    assert c.name == "Count"
+    assert c.children[0].args == {"f": 1}
+
+
+def test_quoted_escapes():
+    c = one('Row(f="a\\"b")')
+    assert c.args["f"] == 'a"b'
+    c = one("Row(f='it\\'s')")
+    assert c.args["f"] == "it's"
+
+
+def test_bareword_vs_keywords():
+    # bare words that merely start with keywords stay strings
+    c = one("Row(f=nullable)")
+    assert c.args["f"] == "nullable"
+    c = one("Row(f=truey)")
+    assert c.args["f"] == "truey"
+
+
+def test_lowercase_set_is_generic():
+    # the special forms match exact literals; 'set' hits the generic rule
+    c = one("set(f=1)")
+    assert c.name == "set" and c.args == {"f": 1}
+
+
+def test_uint_slice_values():
+    c = one("Row(f=[1,2,3])")
+    assert c.args["f"] == [1, 2, 3]
+
+
+def test_parse_errors():
+    for bad in ["Row(", "Row(f=)", "(", "Set(10)", "Row(f=1))"]:
+        with pytest.raises(pql.ParseError):
+            pql.parse(bad)
+
+
+def test_roundtrip_str():
+    src = "Count(Intersect(Row(a=1), Row(b=2)))"
+    c = one(src)
+    assert pql.parse(str(c)).calls[0] == c
+
+
+def test_clone_independent():
+    c = one("GroupBy(Rows(a), limit=5)")
+    d = c.clone()
+    d.args["limit"] = 6
+    d.children[0].args["x"] = 1
+    assert c.args["limit"] == 5
+    assert "x" not in c.children[0].args
