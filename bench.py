@@ -1,0 +1,158 @@
+"""Benchmark: PQL Count/TopN over a ~10-billion-bit index on one TPU chip.
+
+Mirrors BASELINE.json config 2/4: a dense bitmap index of
+S shards x R rows x 2^20 columns (~10.7e9 bits at full size), querying
+
+* ``Count(Intersect(Row(a), Row(b)))`` — the headline PQL shape —
+  measured both batched (one XLA launch evaluating a batch of query pairs,
+  the TPU serving mode) and sequentially (one dispatch per query), and
+* ``TopN`` — a full popcount scan of every row + top_k.
+
+Baseline: the same computation in single-core numpy (``np.bitwise_count``)
+on the host, timed on a shard subset and scaled. The reference publishes no
+absolute numbers (BASELINE.md) and no Go toolchain exists in this image, so
+vectorized-numpy-popcount stands in for the reference's roaring word-loop
+kernels (roaring.go:568 intersectionCountBitmapBitmap is the same
+AND+popcount word loop).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _on_accelerator() -> bool:
+    return jax.devices()[0].platform not in ("cpu",)
+
+
+@partial(jax.jit, static_argnames=())
+def _count_pair(bits, ra, rb):
+    a = bits[:, ra]
+    b = bits[:, rb]
+    return jnp.sum(lax.population_count(a & b).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def _count_pairs_batched(bits, ras, rbs):
+    """One launch, B query pairs -> int32[B] totals. A device-side scan —
+    not vmap, which would materialize the [B, S, W] gather (21 GiB at full
+    size); each step streams just the two query rows from HBM."""
+
+    def body(_, q):
+        ra, rb = q
+        a = bits[:, ra]
+        b = bits[:, rb]
+        return None, jnp.sum(lax.population_count(a & b).astype(jnp.int32))
+
+    _, counts = lax.scan(body, None, (ras, rbs))
+    return counts
+
+
+@jax.jit
+def _topn_counts(bits):
+    counts = jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=(0, 2))
+    return lax.top_k(counts, 10)
+
+
+def main() -> None:
+    accel = _on_accelerator()
+    # Full size on the TPU chip (~10.7e9 bits = 1.34 GiB); small on CPU CI.
+    if accel:
+        S, R, W = 160, 64, 32768
+    else:
+        S, R, W = 16, 32, 2048
+
+    key = jax.random.PRNGKey(7)
+    # ~25% density via AND of two uniform word tensors, generated on device
+    # (no host->device transfer of the index itself).
+    k1, k2 = jax.random.split(key)
+    bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
+        k2, (S, R, W), dtype=jnp.uint32
+    )
+    bits = jax.block_until_ready(bits)
+    n_bits = S * R * W * 32
+
+    rng = np.random.default_rng(3)
+    B = 1024 if accel else 64
+    ras = jnp.asarray(rng.integers(0, R, size=B), jnp.int32)
+    rbs = jnp.asarray(rng.integers(0, R, size=B), jnp.int32)
+
+    # NOTE on timing: in this dev environment the chip sits behind a relay
+    # with ~64 ms round-trip per dispatch, and block_until_ready does not
+    # reliably wait — every measurement below syncs by pulling the (tiny)
+    # result to host, so per-call numbers INCLUDE the relay RTT.
+
+    # -- batched Count(Intersect) -------------------------------------------
+    int(np.asarray(_count_pairs_batched(bits, ras, rbs)).sum())  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for r in range(reps):
+        out = _count_pairs_batched(
+            bits, jnp.roll(ras, r), jnp.roll(rbs, r)
+        )
+        int(np.asarray(out).astype(np.int64).sum())
+    batched_qps = reps * B / (time.perf_counter() - t0)
+
+    # -- sequential Count(Intersect) ----------------------------------------
+    int(np.asarray(_count_pair(bits, ras[0], rbs[0])).sum())  # compile
+    n_seq = 20
+    t0 = time.perf_counter()
+    for i in range(n_seq):
+        per_shard = _count_pair(bits, ras[i % B], rbs[i % B])
+        int(np.asarray(per_shard).astype(np.int64).sum())
+    seq_qps = n_seq / (time.perf_counter() - t0)
+
+    # -- TopN ---------------------------------------------------------------
+    np.asarray(_topn_counts(bits))  # compile
+    lat = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(_topn_counts(bits))
+        lat.append(time.perf_counter() - t0)
+    topn_p50_ms = sorted(lat)[len(lat) // 2] * 1e3
+
+    # -- CPU baseline (numpy popcount on a shard subset, scaled) ------------
+    S_sub = max(1, S // 16)
+    sub = np.asarray(bits[:S_sub])  # [S_sub, R, W]
+    qa, qb = int(ras[0]), int(rbs[0])
+    # per-query: AND + popcount of two rows across all shards
+    t0 = time.perf_counter()
+    cpu_reps = 3
+    for _ in range(cpu_reps):
+        int(np.bitwise_count(sub[:, qa] & sub[:, qb]).sum())
+    cpu_query_t = (time.perf_counter() - t0) / cpu_reps * (S / S_sub)
+    cpu_qps = 1.0 / cpu_query_t
+    t0 = time.perf_counter()
+    np.bitwise_count(sub).sum(axis=(0, 2))
+    cpu_topn_ms = (time.perf_counter() - t0) * (S / S_sub) * 1e3
+
+    result = {
+        "metric": "count_intersect_qps_per_chip",
+        "value": round(batched_qps, 1),
+        "unit": f"Count(Intersect) queries/sec/chip, batched, {n_bits/1e9:.1f}e9-bit index",
+        "vs_baseline": round(batched_qps / cpu_qps, 1),
+        "sequential_qps": round(seq_qps, 1),
+        "sequential_vs_baseline": round(seq_qps / cpu_qps, 1),
+        "topn_p50_ms": round(topn_p50_ms, 2),
+        "topn_vs_baseline": round(cpu_topn_ms / topn_p50_ms, 1),
+        "cpu_baseline_qps": round(cpu_qps, 1),
+        "platform": jax.devices()[0].platform,
+        "index_bits": n_bits,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

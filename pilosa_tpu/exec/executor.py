@@ -103,6 +103,9 @@ class Executor:
         dispatch (executor.go:2625-2712 translateCall): each call shape
         names which args hold column keys vs row keys."""
         name = call.name
+        if name == "GroupBy":
+            self._translate_groupby(idx, call)
+            return
         if name in ("Set", "Clear", "Row", "Range", "SetColumnAttrs", "ClearRow"):
             col_key = "_col"
             field_name = call.field_arg()
@@ -163,6 +166,37 @@ class Executor:
         filt = call.args.get("filter")
         if isinstance(filt, Call):
             self._translate_call(idx, filt)
+
+    def _translate_groupby(self, idx: Index, call: Call) -> None:
+        """The `previous` paging list holds one row key/id per child field
+        (reference executor.go:2718-2748 translateGroupByCall)."""
+        for child in call.children:
+            self._translate_call(idx, child)
+        filt = call.args.get("filter")
+        if isinstance(filt, Call):
+            self._translate_call(idx, filt)
+        previous = call.args.get("previous")
+        if previous is None:
+            return
+        if not isinstance(previous, list):
+            raise ExecuteError("'previous' argument must be a list")
+        if len(previous) != len(call.children):
+            raise ExecuteError(
+                "'previous' argument must have a value for each GroupBy field"
+            )
+        for i, (child, prev) in enumerate(zip(call.children, previous)):
+            fname = child.args.get("_field")
+            field = idx.field(fname) if fname else None
+            if field is None:
+                continue
+            if field.field_type == FIELD_TYPE_BOOL and isinstance(prev, bool):
+                previous[i] = TRUE_ROW_ID if prev else FALSE_ROW_ID
+            elif isinstance(prev, str):
+                if not field.keys:
+                    raise ExecuteError(
+                        f"prev value must be a uint64 for field {fname!r}"
+                    )
+                previous[i] = self.translator.translate_key(idx.name, fname, prev)
 
     def _translate_result(self, idx: Index, call: Call, result: Any) -> Any:
         """ids -> keys on results (reference executor.go:2783-2907)."""
@@ -308,7 +342,8 @@ class Executor:
             raise ExecuteError("Shift() takes one argument")
         n, ok = call.int_arg("n")
         child = self._bitmap_call(idx, call.children[0], shards)
-        return child.shift(n if ok else 1)
+        # default n=0: unchanged row (reference executor.go:1773)
+        return child.shift(n if ok else 0)
 
     def _field_row(self, field: Field | None, row_id: int, shards: list[int], view: str = VIEW_STANDARD) -> Row:
         out = Row(n_words=self.holder.n_words)
@@ -493,60 +528,59 @@ class Executor:
             raise FieldNotFoundError(f"field not found: {fname}")
         return field
 
-    def _execute_sum(self, idx: Index, call: Call, shards: list[int] | None) -> ValCount:
-        """reference executor.go:409-442 + executeSumCountShard."""
+    def _bsi_agg_shards(self, idx: Index, call: Call, shards: list[int] | None):
+        """Shared scaffold for Sum/Min/Max: resolve the BSI field, the
+        optional filter child, and yield per-shard
+        (planes, exists, sign, filter_words) tensors."""
         shards = self._shards_for(idx, shards)
         field = self._bsi_field(idx, call)
         filt = self._sum_filter(idx, call, shards)
         view = field.view(field.bsi_view_name())
-        total, count = 0, 0
-        if view is not None:
+
+        def per_shard():
+            if view is None:
+                return
             ones = np.full(field.n_words, 0xFFFFFFFF, dtype=np.uint32)
             for shard in shards:
                 frag = view.fragment(shard)
                 if frag is None:
                     continue
-                planes, exists, sign = frag.bsi_tensors(field.bit_depth)
                 fw = ones
                 if filt is not None:
                     fw = filt.segments.get(shard)
                     if fw is None:
                         continue
-                s, c = bsi.sum_host(planes, exists, sign, fw, depth=field.bit_depth)
-                total += s
-                count += c
+                planes, exists, sign = frag.bsi_tensors(field.bit_depth)
+                yield planes, exists, sign, fw
+
+        return field, per_shard()
+
+    def _execute_sum(self, idx: Index, call: Call, shards: list[int] | None) -> ValCount:
+        """reference executor.go:409-442 + executeSumCountShard."""
+        field, tensors = self._bsi_agg_shards(idx, call, shards)
+        total, count = 0, 0
+        for planes, exists, sign, fw in tensors:
+            s, c = bsi.sum_host(planes, exists, sign, fw, depth=field.bit_depth)
+            total += s
+            count += c
         if count == 0:
             return ValCount()
         return ValCount(value=total + count * field.base, count=count)
 
     def _execute_min_max(self, idx: Index, call: Call, shards: list[int] | None, maximal: bool) -> ValCount:
-        shards = self._shards_for(idx, shards)
-        field = self._bsi_field(idx, call)
-        filt = self._sum_filter(idx, call, shards)
-        view = field.view(field.bsi_view_name())
+        field, tensors = self._bsi_agg_shards(idx, call, shards)
         best: ValCount | None = None
-        if view is not None:
-            ones = np.full(field.n_words, 0xFFFFFFFF, dtype=np.uint32)
-            for shard in shards:
-                frag = view.fragment(shard)
-                if frag is None:
-                    continue
-                planes, exists, sign = frag.bsi_tensors(field.bit_depth)
-                fw = ones
-                if filt is not None:
-                    fw = filt.segments.get(shard)
-                    if fw is None:
-                        continue
-                value, count = bsi.min_max_host(
-                    planes, exists, sign, fw, depth=field.bit_depth, maximal=maximal
-                )
-                if count == 0:
-                    continue
-                value += field.base
-                if best is None or (value > best.value if maximal else value < best.value):
-                    best = ValCount(value=value, count=count)
-                elif value == best.value:
-                    best.count += count
+        for planes, exists, sign, fw in tensors:
+            value, count = bsi.min_max_host(
+                planes, exists, sign, fw, depth=field.bit_depth, maximal=maximal
+            )
+            if count == 0:
+                continue
+            value += field.base
+            if best is None or (value > best.value if maximal else value < best.value):
+                best = ValCount(value=value, count=count)
+            elif value == best.value:
+                best.count += count
         return best or ValCount()
 
     def _execute_min_max_row(self, idx: Index, call: Call, shards: list[int] | None, maximal: bool) -> Pair:
@@ -749,11 +783,13 @@ class Executor:
                     continue
                 ids, row_counts = frag.row_counts()
                 if src is not None:
-                    # Row totals accumulate over every shard the row exists
-                    # in, even where the src bitmap is empty — the tanimoto
-                    # denominator needs the full row cardinality.
-                    for rid, t in zip(ids, row_counts.tolist()):
-                        row_totals[rid] = row_totals.get(rid, 0) + t
+                    if has_tanimoto:
+                        # Row totals accumulate over every shard the row
+                        # exists in, even where the src bitmap is empty —
+                        # the tanimoto denominator needs the full row
+                        # cardinality.
+                        for rid, t in zip(ids, row_counts.tolist()):
+                            row_totals[rid] = row_totals.get(rid, 0) + t
                     seg = src.segments.get(shard)
                     if seg is None:
                         continue

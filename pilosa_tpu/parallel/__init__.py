@@ -1,0 +1,15 @@
+"""Distribution over TPU device meshes.
+
+The reference distributes fragments across nodes by jump-hash over an HTTP
+cluster and fans queries out as goroutine map-reduce (reference
+cluster.go:922-934, executor.go:2454-2611). Here the same shard axis maps
+onto a ``jax.sharding.Mesh`` axis: fragments stack into
+``uint32[shards, rows, words]`` tensors laid out with ``NamedSharding``,
+queries compile once with pjit and XLA inserts the ICI collectives for the
+reduce step (psum of per-shard counts, all-gather of row slices across a
+row-sharded axis)."""
+
+from pilosa_tpu.parallel.mesh import default_mesh, mesh_shape_for
+from pilosa_tpu.parallel.sharded import ShardedField
+
+__all__ = ["default_mesh", "mesh_shape_for", "ShardedField"]

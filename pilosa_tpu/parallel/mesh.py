@@ -1,0 +1,35 @@
+"""Device mesh construction.
+
+Two mesh axes cover this workload's parallelism inventory (SURVEY §2.3):
+
+* ``shards`` — the data-parallel axis: columns striped into 2^20-wide
+  shards, each device slice owning a contiguous set of shards (the
+  analogue of the reference's shard→node jump-hash placement,
+  cluster.go:858-934, made static because TPU meshes are static).
+* ``rows`` — the tensor-parallel-style axis: a fragment's row dimension
+  split across devices, so row-count scans (TopN/GroupBy) and BSI
+  plane walks parallelize within one shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n_devices: int) -> tuple[int, int]:
+    """(shards, rows) axis sizes: prefer sharding columns; give the row
+    axis a factor of 2 when the device count allows."""
+    if n_devices % 2 == 0 and n_devices > 2:
+        return n_devices // 2, 2
+    return n_devices, 1
+
+
+def default_mesh(n_devices: int | None = None, axis_names=("shards", "rows")) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    s, r = mesh_shape_for(len(devices))
+    return Mesh(np.array(devices).reshape(s, r), axis_names)

@@ -1,0 +1,193 @@
+"""Mesh-sharded fragment stacks and fused query kernels.
+
+A ``ShardedField`` holds one field/view's fragments as a single
+``uint32[n_shards, n_rows, W]`` tensor laid out over the mesh:
+
+    bits: NamedSharding(mesh, P("shards", "rows", None))
+
+Shard axis 0 is the reference's shard→node placement made static; row axis
+1 is split tensor-parallel style. Queries are jitted once per shape:
+
+* pair ops (Intersect/Union/Difference/Xor + Count): gather two rows —
+  XLA all-gathers the row slice across the ``rows`` axis — then fused
+  AND/popcount per shard and a psum-style reduce over the mesh.
+* TopN: per-row popcounts reduced over (shards, words) — an ICI
+  all-reduce — then ``lax.top_k`` replicated.
+* BSI aggregates: plane-walk kernels from ops/bsi vmapped over shards.
+
+The single-node executor (exec/executor.py) uses per-fragment dicts for
+flexibility; this stacked path is the high-throughput lane used by the
+benchmark and the distributed query planner.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.core.field import Field
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.ops import bitops
+
+_OPS = {
+    "intersect": lambda a, b: a & b,
+    "union": lambda a, b: a | b,
+    "difference": lambda a, b: a & ~b,
+    "xor": lambda a, b: a ^ b,
+}
+
+
+@partial(jax.jit, static_argnames=("op",))
+def pair_op_count(bits, ra: jax.Array, rb: jax.Array, *, op: str) -> jax.Array:
+    """Per-shard counts of op(Row(ra), Row(rb)) -> int32[n_shards].
+
+    Summed to a Python int host-side so totals beyond 2^31 stay exact."""
+    a = bits[:, ra]  # [S, W]; all-gathered across the rows axis by XLA
+    b = bits[:, rb]
+    return jnp.sum(
+        lax.population_count(_OPS[op](a, b)).astype(jnp.int32), axis=-1
+    )
+
+
+@jax.jit
+def row_counts_all(bits) -> jax.Array:
+    """Popcount of every row summed over shards -> int32[n_rows].
+
+    The all-shards reduce rides ICI (XLA partitions the sum over the
+    ``shards`` axis then all-reduces)."""
+    return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=(0, 2))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def topn_counts(bits, *, n: int):
+    """(top-n counts, row slots) by per-row popcount."""
+    counts = row_counts_all(bits)
+    return lax.top_k(counts, n)
+
+
+@partial(jax.jit, donate_argnums=0)
+def apply_updates(bits, set_mask, clear_mask):
+    """One write step: OR in set bits, ANDNOT clear bits. Donated so the
+    update is in-place in HBM (the op-log flush analogue,
+    reference fragment.go:2284-2293)."""
+    return (bits | set_mask) & ~clear_mask
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def bsi_sum_planes(planes, exists, sign, filter_words, *, depth: int):
+    """Per-plane popcounts for Sum over a sharded BSI stack.
+
+    planes: [S, depth, W]; exists/sign/filter: [S, W]. Returns
+    (pos[depth], neg[depth], count) int32 — combined with place values on
+    host for arbitrary precision."""
+    f = exists & filter_words
+    pos = f & ~sign
+    neg = f & sign
+    pos_counts = []
+    neg_counts = []
+    for k in range(depth):
+        p = planes[:, k]
+        pos_counts.append(jnp.sum(lax.population_count(p & pos).astype(jnp.int32)))
+        neg_counts.append(jnp.sum(lax.population_count(p & neg).astype(jnp.int32)))
+    count = jnp.sum(lax.population_count(f).astype(jnp.int32))
+    return (
+        jnp.stack(pos_counts) if depth else jnp.zeros((0,), jnp.int32),
+        jnp.stack(neg_counts) if depth else jnp.zeros((0,), jnp.int32),
+        count,
+    )
+
+
+class ShardedField:
+    """A field/view's fragments stacked onto a device mesh."""
+
+    def __init__(
+        self,
+        bits: np.ndarray | jax.Array,
+        row_ids: list[int],
+        shard_ids: list[int],
+        mesh: Mesh | None = None,
+    ):
+        self.row_ids = list(row_ids)
+        self.shard_ids = list(shard_ids)
+        self._slot_of = {r: i for i, r in enumerate(self.row_ids)}
+        self.mesh = mesh
+        if mesh is not None:
+            sharding = NamedSharding(mesh, P("shards", "rows", None))
+            self.bits = jax.device_put(bits, sharding)
+        else:
+            self.bits = jnp.asarray(bits)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_field(
+        cls,
+        field: Field,
+        mesh: Mesh | None = None,
+        view: str = VIEW_STANDARD,
+        pad_shards_to: int | None = None,
+        pad_rows_to: int | None = None,
+    ) -> "ShardedField":
+        """Stack a field's per-shard fragments into [S, R, W]. Rows are the
+        union of row ids across shards; both axes pad to mesh-divisible
+        sizes."""
+        v = field.view(view)
+        frags = dict(v.fragments) if v is not None else {}
+        shard_ids = sorted(frags)
+        row_ids = sorted({r for f in frags.values() for r in f.row_ids()})
+        S = max(len(shard_ids), 1)
+        R = max(len(row_ids), 1)
+        if mesh is not None:
+            s_ax = mesh.shape["shards"]
+            r_ax = mesh.shape["rows"]
+            S = -(-S // s_ax) * s_ax
+            R = -(-R // r_ax) * r_ax
+        if pad_shards_to:
+            S = max(S, pad_shards_to)
+        if pad_rows_to:
+            R = max(R, pad_rows_to)
+        bits = np.zeros((S, R, field.n_words), dtype=np.uint32)
+        for si, shard in enumerate(shard_ids):
+            frag = frags[shard]
+            for ri, row in enumerate(row_ids):
+                if frag.has_row(row):
+                    bits[si, ri] = frag.row_words_host(row)
+        return cls(bits, row_ids, shard_ids, mesh)
+
+    # -- queries ------------------------------------------------------------
+
+    def slot(self, row_id: int) -> int:
+        s = self._slot_of.get(row_id)
+        if s is None:
+            raise KeyError(f"row {row_id} not present")
+        return s
+
+    def count_pair(self, row_a: int, row_b: int, op: str = "intersect") -> int:
+        per_shard = pair_op_count(
+            self.bits,
+            jnp.asarray(self.slot(row_a), jnp.int32),
+            jnp.asarray(self.slot(row_b), jnp.int32),
+            op=op,
+        )
+        return int(np.asarray(per_shard).astype(np.int64).sum())
+
+    def topn(self, n: int) -> list[tuple[int, int]]:
+        n = min(n, len(self.row_ids)) or 1
+        counts, slots = topn_counts(self.bits, n=n)
+        counts = np.asarray(counts)
+        slots = np.asarray(slots)
+        out = []
+        for c, s in zip(counts.tolist(), slots.tolist()):
+            if c > 0 and s < len(self.row_ids):
+                out.append((self.row_ids[s], c))
+        return out
+
+    def apply_updates(self, set_mask, clear_mask) -> None:
+        """Donating write step; masks must match self.bits sharding."""
+        self.bits = apply_updates(self.bits, set_mask, clear_mask)

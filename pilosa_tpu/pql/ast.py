@@ -131,9 +131,20 @@ class Call:
         return any(isinstance(v, Condition) for v in self.args.values())
 
     def clone(self) -> "Call":
+        def copy_value(v):
+            if isinstance(v, Call):
+                return v.clone()
+            if isinstance(v, Condition):
+                return Condition(
+                    v.op, list(v.value) if isinstance(v.value, list) else v.value
+                )
+            if isinstance(v, list):
+                return [copy_value(x) for x in v]
+            return v
+
         return Call(
             self.name,
-            dict(self.args),
+            {k: copy_value(v) for k, v in self.args.items()},
             [c.clone() for c in self.children],
         )
 

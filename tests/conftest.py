@@ -4,18 +4,25 @@ Runs the whole suite on a virtual 8-device CPU mesh (multi-chip sharding is
 validated without TPU hardware, mirroring how the reference boots real
 in-process multi-node clusters in tests — reference test/pilosa.go:344-400)
 and with a small shard width (2^14) so fragment tensors stay tiny, the way
-the reference selects SHARD_WIDTH=2^16..2^32 via build tags for tests
-(reference Makefile:9, shardwidth/16.go).
+the reference selects SHARD_WIDTH via build tags (reference Makefile:9,
+shardwidth/16.go).
 
-Must run before any jax import, hence conftest at collection time.
+Note: the machine's sitecustomize registers the axon TPU backend and pins
+``jax.config.jax_platforms``, so the env var alone is not enough — the
+config value is overridden here before any backend initializes (conftest
+runs at collection time, before test modules import jax-dependent code).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH", "14")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -540,3 +540,40 @@ class TestReviewRegressions:
         assert ex.execute("i", "TopN(f, Row(s=9), tanimotoThreshold=50)")[0] == [
             Pair(id=1, count=1)
         ]
+
+
+class TestReviewRegressions2:
+    def test_execute_does_not_mutate_query_ast(self):
+        import pilosa_tpu.pql as pql
+
+        h = Holder()
+        h.create_index("ki", keys=True)
+        h.index("ki").create_field("g", FieldOptions(keys=True))
+        h.index("ki").create_field("a")
+        e = Executor(h)
+        e.execute("ki", 'Set("c1", g="k")')
+        e.execute("ki", 'Set("c1", a=1)')
+        q = pql.parse('GroupBy(Rows(a), filter=Row(g="k"))')
+        r1 = e.execute("ki", q)
+        r2 = e.execute("ki", q)  # must not see a mutated AST
+        assert r1 == r2
+        assert q.calls[0].args["filter"].args["g"] == "k"
+
+    def test_shift_default_is_zero(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(3, f=1)")
+        assert cols(ex.execute("i", "Shift(Row(f=1))")[0]) == [3]
+        assert cols(ex.execute("i", "Shift(Row(f=1), n=1)")[0]) == [4]
+
+    def test_groupby_previous_keys_translated(self):
+        h = Holder()
+        h.create_index("ki", keys=True)
+        h.index("ki").create_field("g", FieldOptions(keys=True))
+        e = Executor(h)
+        for col, row in [("c1", "x"), ("c2", "y"), ("c3", "z")]:
+            e.execute("ki", f'Set("{col}", g="{row}")')
+        all_groups = e.execute("ki", "GroupBy(Rows(g))")[0]
+        assert len(all_groups) == 3
+        paged = e.execute("ki", 'GroupBy(Rows(g), previous=["x"])')[0]
+        assert len(paged) == 2
+        assert all(gc.group[0].row_key in ("y", "z") for gc in paged)
