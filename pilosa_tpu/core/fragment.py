@@ -32,6 +32,7 @@ reference amortizes fsyncs.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Iterable
 
 import numpy as np
@@ -84,6 +85,10 @@ class Fragment:
         # (reference fragment.go:84 MaxOpN, 2284-2293).
         self.op_n = 0
         self.on_op = None  # callback(fragment) after mutations
+        # optional storage.FragmentFile: mutations append to its op log
+        # (reference fragment.go:453 storage.OpWriter). Lock order is
+        # always fragment._lock (outer) -> store lock (inner).
+        self.store = None
 
     # -- row bookkeeping ----------------------------------------------------
 
@@ -131,16 +136,38 @@ class Fragment:
         if self.on_op is not None:
             self.on_op(self)
 
+    def _check_persistable(self, row: int) -> None:
+        """With a store attached, reject un-persistable row ids BEFORE
+        mutating so memory and op log can't diverge."""
+        if self.store is not None:
+            self.store.check_row(row)
+
+    @contextmanager
+    def _batched_store(self):
+        """Coalesce one logical mutation's ops into single batch records
+        (one locked append instead of one write+flush per bit)."""
+        if self.store is None:
+            yield
+            return
+        self.store.begin_batch()
+        try:
+            yield
+        finally:
+            self.store.end_batch()
+
     def set_bit(self, row: int, col: int) -> bool:
         """Set bit (row, col-offset); returns True if it changed
         (reference fragment.go:645-713)."""
         with self._lock:
+            self._check_persistable(row)
             s = self._slot(row, create=True)
             w, b = col >> 5, np.uint32(1 << (col & 31))
             if self._host[s, w] & b:
                 return False
             self._host[s, w] |= b
             self._touch(s)
+            if self.store is not None:
+                self.store.log_add(row, col)
             return True
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -153,6 +180,8 @@ class Fragment:
                 return False
             self._host[s, w] &= ~b
             self._touch(s)
+            if self.store is not None:
+                self.store.log_remove(row, col)
             return True
 
     def get_bit(self, row: int, col: int) -> bool:
@@ -166,12 +195,25 @@ class Fragment:
         """Replace a whole row (reference fragment.go:781-834 setRow);
         returns True if the row changed."""
         with self._lock:
+            self._check_persistable(row)
             s = self._slot(row, create=True)
             words = np.asarray(words, dtype=np.uint32)
             if np.array_equal(self._host[s], words):
                 return False
+            old = self._host[s].copy()
             self._host[s] = words
             self._touch(s)
+            # log AFTER applying: a snapshot triggered mid-logging then
+            # serializes the new state, against which these ops replay
+            # idempotently
+            if self.store is not None:
+                added = words & ~old
+                removed = old & ~words
+                with self._batched_store():
+                    if added.any():
+                        self.store.log_add_mask(row, added)
+                    if removed.any():
+                        self.store.log_remove_mask(row, removed)
             return True
 
     def clear_row(self, row: int) -> bool:
@@ -182,12 +224,16 @@ class Fragment:
         (the import-roaring merge unit, reference roaring.go:1463
         ImportRoaringBits)."""
         with self._lock:
+            self._check_persistable(row)
             s = self._slot(row, create=True)
             words = np.asarray(words, dtype=np.uint32)
-            added = bitops.popcount_host(words & ~self._host[s])
+            added_mask = words & ~self._host[s]
+            added = bitops.popcount_host(added_mask)
             if added:
                 self._host[s] |= words
                 self._touch(s)
+                if self.store is not None:
+                    self.store.log_add_mask(row, added_mask)
             return added
 
     def difference_row_words(self, row: int, words: np.ndarray) -> int:
@@ -197,10 +243,13 @@ class Fragment:
             if s is None:
                 return 0
             words = np.asarray(words, dtype=np.uint32)
-            removed = bitops.popcount_host(words & self._host[s])
+            removed_mask = words & self._host[s]
+            removed = bitops.popcount_host(removed_mask)
             if removed:
                 self._host[s] &= ~words
                 self._touch(s)
+                if self.store is not None:
+                    self.store.log_remove_mask(row, removed_mask)
             return removed
 
     def import_bits(self, rows: np.ndarray, cols: np.ndarray, clear: bool = False) -> int:
@@ -210,7 +259,7 @@ class Fragment:
         cols = np.asarray(cols, dtype=np.int64)
         if rows.size == 0:
             return 0
-        with self._lock:
+        with self._lock, self._batched_store():
             # Group by row directly (never via row*width+col positions,
             # which would wrap uint64 for hashed row ids).
             row_ids, inverse = np.unique(rows, return_inverse=True)
@@ -232,7 +281,8 @@ class Fragment:
         """Mutex-field write: clear col in every other row, set (row, col)
         (reference fragment.go:715-759 setBit w/ mutex vector,
         :3082-3152)."""
-        with self._lock:
+        with self._lock, self._batched_store():
+            self._check_persistable(row)
             w, b = col >> 5, np.uint32(1 << (col & 31))
             target = self._slot(row, create=True)
             col_word = self._host[:, w]
@@ -240,13 +290,9 @@ class Fragment:
             changed = False
             for s in holders:
                 if s != target:
-                    self._host[s, w] &= ~b
-                    self._touch(int(s))
-                    changed = True
-            if not self._host[target, w] & b:
-                self._host[target, w] |= b
-                self._touch(target)
-                changed = True
+                    # via clear_bit so the op log sees the clears
+                    changed |= self.clear_bit(self._rowids[int(s)], col)
+            changed |= self.set_bit(row, col)
             return changed
 
     # -- device sync & query views -----------------------------------------
@@ -348,7 +394,7 @@ class Fragment:
     def set_value(self, col: int, bit_depth: int, value: int) -> bool:
         """Write a stored (already base-offset) value for a column
         (reference fragment.go:929-1003 setValueBase)."""
-        with self._lock:
+        with self._lock, self._batched_store():
             changed = self.set_bit(BSI_EXISTS_BIT, col)
             mag = abs(value)
             if value < 0:
@@ -378,7 +424,7 @@ class Fragment:
 
     def clear_value(self, col: int) -> bool:
         """Remove a column's BSI value entirely."""
-        with self._lock:
+        with self._lock, self._batched_store():
             if not self.get_bit(BSI_EXISTS_BIT, col):
                 return False
             for row in list(self._slot_of):
@@ -396,7 +442,7 @@ class Fragment:
         # reference applies batch entries sequentially, same outcome).
         last = len(cols) - 1 - np.unique(cols[::-1], return_index=True)[1]
         cols, values = cols[last], values[last]
-        with self._lock:
+        with self._lock, self._batched_store():
             col_words = bitops.pack_columns(cols, self.n_words)
             if clear:
                 for row in list(self._slot_of):

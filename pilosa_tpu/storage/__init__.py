@@ -1,0 +1,7 @@
+"""Host-side storage: the roaring interchange codec, per-fragment
+snapshot+op-log files, and the on-disk holder directory tree (reference:
+roaring serialization roaring/roaring.go:1044-1126 + op log :4415-4610,
+fragment persistence fragment.go:311-456, holder tree holder.go:134-198).
+
+Storage never touches the device data path: fragments snapshot from their
+host mirrors, and loads populate host mirrors which lazily sync to HBM."""

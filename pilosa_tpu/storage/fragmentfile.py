@@ -1,0 +1,254 @@
+"""Per-fragment persistence: roaring snapshot + append-only op log.
+
+The reference persists each fragment as one roaring file whose container
+section is a snapshot and whose tail is an op log; mutations append ops and
+the whole file is atomically rewritten once ``opN > MaxOpN`` (reference
+fragment.go:84 MaxOpN=10000, :311-456 openStorage, :2325-2381 snapshot via
+temp file + rename, docs/architecture.md). Same model here, writing from
+the fragment's host mirror.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.storage import roaring
+
+# reference fragment.go:84.
+MAX_OP_N = 10000
+
+# Batch ops chunk size: bounds the pure-python fnv checksum cost per record.
+_BATCH_CHUNK = 65536
+
+
+class FragmentFile:
+    """Owns the on-disk file of one fragment."""
+
+    def __init__(self, fragment: Fragment, path: str, snapshot_queue: "SnapshotQueue | None" = None):
+        self.fragment = fragment
+        self.path = path
+        self.snapshot_queue = snapshot_queue
+        self._lock = threading.Lock()
+        self._fh = None
+        self.op_n = 0
+        # per-mutation op batching (begin_batch/end_batch): buffered
+        # positions flushed as single batch records. Caller guarantees the
+        # add and remove sets of one batch are disjoint (true for all
+        # Fragment mutators).
+        self._batch_depth = 0
+        self._batch_add: list[np.ndarray] = []
+        self._batch_remove: list[np.ndarray] = []
+        fragment.store = self
+
+    # -- load ---------------------------------------------------------------
+
+    def open(self) -> None:
+        """Load snapshot + replay op log into the fragment's host mirror."""
+        if not os.path.exists(self.path) or os.path.getsize(self.path) == 0:
+            # seed with an empty-bitmap header so the file always starts
+            # with a valid snapshot section (the reference writes the
+            # bitmap before appending ops, fragment.go:311-456)
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "wb") as f:
+                f.write(roaring.serialize(np.empty(0, dtype=np.uint64)))
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            if data:
+                positions = roaring.deserialize(data)
+                width = self.fragment.shard_width
+                rows_arr = positions // np.uint64(width)
+                cols_arr = (positions % np.uint64(width)).astype(np.int64)
+                row_ids, inverse = np.unique(rows_arr, return_inverse=True)
+                host_rows = {}
+                for i, rid in enumerate(row_ids):
+                    mask = inverse == i
+                    host_rows[int(rid)] = bitops.pack_columns(
+                        cols_arr[mask], self.fragment.n_words
+                    )
+                self.fragment.load_host_rows(host_rows)
+        self._fh = open(self.path, "ab")
+
+    # -- op append ----------------------------------------------------------
+
+    def _positions(self, row: int, mask: np.ndarray) -> np.ndarray:
+        width = self.fragment.shard_width
+        if row > (2**64 - 1) // width:
+            raise ValueError(
+                f"row id {row} too large to persist at shard width {width}"
+            )
+        return np.uint64(row) * np.uint64(width) + bitops.unpack_columns(mask)
+
+    def _append(self, record: bytes, count: int) -> None:
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "ab")
+            self._fh.write(record)
+            self._fh.flush()
+            self.op_n += count
+        if self.op_n > MAX_OP_N:
+            self.request_snapshot()
+
+    def check_row(self, row: int) -> None:
+        """Raise BEFORE any mutation if a row id cannot be persisted
+        (positions are row*width+col in uint64, so rows are bounded at
+        ~2^44 for the default width once a store is attached)."""
+        width = self.fragment.shard_width
+        if row > (2**64 - 1) // width:
+            raise ValueError(
+                f"row id {row} too large to persist at shard width {width}"
+            )
+
+    def _pos(self, row: int, col: int) -> int:
+        self.check_row(row)
+        return row * self.fragment.shard_width + col
+
+    # -- batching ----------------------------------------------------------
+
+    def begin_batch(self) -> None:
+        self._batch_depth += 1
+
+    def end_batch(self) -> None:
+        self._batch_depth -= 1
+        if self._batch_depth > 0:
+            return
+        adds, self._batch_add = self._batch_add, []
+        removes, self._batch_remove = self._batch_remove, []
+        if adds:
+            self._emit_batch(roaring.OP_ADD_BATCH, np.concatenate(adds))
+        if removes:
+            self._emit_batch(roaring.OP_REMOVE_BATCH, np.concatenate(removes))
+
+    def _emit_batch(self, op_type: int, positions: np.ndarray) -> None:
+        for i in range(0, len(positions), _BATCH_CHUNK):
+            chunk = positions[i : i + _BATCH_CHUNK]
+            self._append(roaring.encode_op(op_type, chunk), len(chunk))
+
+    def log_add(self, row: int, col: int) -> None:
+        pos = self._pos(row, col)
+        if self._batch_depth:
+            self._batch_add.append(np.array([pos], dtype=np.uint64))
+            return
+        self._append(roaring.encode_op(roaring.OP_ADD, pos), 1)
+
+    def log_remove(self, row: int, col: int) -> None:
+        pos = self._pos(row, col)
+        if self._batch_depth:
+            self._batch_remove.append(np.array([pos], dtype=np.uint64))
+            return
+        self._append(roaring.encode_op(roaring.OP_REMOVE, pos), 1)
+
+    def log_add_mask(self, row: int, mask: np.ndarray) -> None:
+        positions = self._positions(row, mask)
+        if self._batch_depth:
+            self._batch_add.append(positions)
+            return
+        self._emit_batch(roaring.OP_ADD_BATCH, positions)
+
+    def log_remove_mask(self, row: int, mask: np.ndarray) -> None:
+        positions = self._positions(row, mask)
+        if self._batch_depth:
+            self._batch_remove.append(positions)
+            return
+        self._emit_batch(roaring.OP_REMOVE_BATCH, positions)
+
+    # -- snapshot -----------------------------------------------------------
+
+    def request_snapshot(self) -> None:
+        if self.snapshot_queue is not None:
+            self.snapshot_queue.enqueue(self)
+        else:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        """Atomic rewrite: temp file + rename (reference
+        fragment.go:2335-2381). Takes the fragment lock FIRST (matching the
+        writer path's fragment->store lock order) so a concurrent mutation
+        can't interleave between the state gather and the file swap."""
+        with self.fragment._lock, self._lock:
+            positions = self._all_positions()
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                f.write(roaring.serialize(positions))
+                f.flush()
+                os.fsync(f.fileno())
+            if self._fh is not None:
+                self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "ab")
+            self.op_n = 0
+
+    def _all_positions(self) -> np.ndarray:
+        width = self.fragment.shard_width
+        parts = []
+        for row, words in sorted(self.fragment.to_host_rows().items()):
+            if row > (2**64 - 1) // width:
+                raise ValueError(
+                    f"row id {row} too large to persist at shard width {width}"
+                )
+            parts.append(
+                np.uint64(row) * np.uint64(width) + bitops.unpack_columns(words)
+            )
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class SnapshotQueue:
+    """Background snapshot pool (reference fragment.go:185-239: depth 100,
+    2 workers, await support)."""
+
+    def __init__(self, workers: int = 2, depth: int = 100):
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._pending: set[int] = set()
+        self._lock = threading.Lock()
+        self._workers = [
+            threading.Thread(target=self._run, daemon=True) for _ in range(workers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def enqueue(self, store: FragmentFile) -> None:
+        with self._lock:
+            if id(store) in self._pending:
+                return
+            self._pending.add(id(store))
+        try:
+            self._queue.put_nowait(store)
+        except queue.Full:
+            # queue full: snapshot synchronously (reference enqueues
+            # blockingly; sync fallback keeps the writer moving)
+            with self._lock:
+                self._pending.discard(id(store))
+            store.snapshot()
+
+    def _run(self) -> None:
+        while True:
+            store = self._queue.get()
+            if store is None:
+                return
+            try:
+                store.snapshot()
+            finally:
+                with self._lock:
+                    self._pending.discard(id(store))
+                self._queue.task_done()
+
+    def await_all(self) -> None:
+        self._queue.join()
+
+    def stop(self) -> None:
+        for _ in self._workers:
+            self._queue.put(None)

@@ -1,0 +1,208 @@
+"""Persistence tests — the reference's Reopen() crash/restart pattern
+(test/holder.go:62)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.storage import roaring
+from pilosa_tpu.storage.disk import HolderStore
+from pilosa_tpu.storage.fragmentfile import FragmentFile
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def make(path):
+    h = Holder()
+    store = HolderStore(h, str(path))
+    store.open()
+    return h, store, Executor(h, translator=store.translator)
+
+
+class TestHolderStore:
+    def test_reopen_roundtrip(self, tmp_path):
+        h, store, ex = make(tmp_path)
+        idx = h.create_index("i")
+        idx.create_field("f")
+        idx.create_field(
+            "v", FieldOptions(field_type="int", min_=-100, max_=100)
+        )
+        ex.execute("i", "Set(10, f=1)")
+        ex.execute("i", f"Set({SHARD_WIDTH + 3}, f=1)")
+        ex.execute("i", "Set(10, v=-42)")
+        ex.execute("i", 'SetRowAttrs(f, 1, tag="x")')
+        ex.execute("i", 'SetColumnAttrs(10, kind="k")')
+        store.close()
+
+        h2, store2, ex2 = make(tmp_path)
+        assert h2.index("i") is not None
+        row = ex2.execute("i", "Row(f=1)")[0]
+        assert [int(c) for c in row.columns()] == [10, SHARD_WIDTH + 3]
+        assert row.attrs == {"tag": "x"}
+        assert h2.field("i", "v").value(10) == (-42, True)
+        assert h2.index("i").column_attrs.attrs(10) == {"kind": "k"}
+        # existence persisted
+        assert ex2.execute("i", "Count(Not(Union()))") == [2]
+        store2.close()
+
+    def test_oplog_durable_without_sync(self, tmp_path):
+        # mutations must survive without close() (op-log fsync'd appends)
+        h, store, ex = make(tmp_path)
+        h.create_index("i").create_field("f")
+        store.sync()  # schema needs one sync
+        ex.execute("i", "Set(5, f=2)")
+        ex.execute("i", "Set(6, f=2)")
+        ex.execute("i", "Clear(5, f=2)")
+        # simulate crash: no close, fresh holder from the same dir
+        h2, store2, ex2 = make(tmp_path)
+        assert [int(c) for c in ex2.execute("i", "Row(f=2)")[0].columns()] == [6]
+        store2.close()
+
+    def test_keys_persist(self, tmp_path):
+        h, store, ex = make(tmp_path)
+        h.create_index("ki", keys=True).create_field("f", FieldOptions(keys=True))
+        ex.execute("ki", 'Set("alpha", f="one")')
+        store.close()
+        h2, store2, ex2 = make(tmp_path)
+        row = ex2.execute("ki", 'Row(f="one")')[0]
+        assert row.keys == ["alpha"]
+        # same key maps to the same id after reopen
+        assert store2.translator.translate_key("ki", "", "alpha") == 1
+        store2.close()
+
+    def test_time_views_persist(self, tmp_path):
+        h, store, ex = make(tmp_path)
+        h.create_index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="YMD")
+        )
+        ex.execute("i", "Set(1, t=9, 2018-03-04T00:00)")
+        store.close()
+        h2, store2, ex2 = make(tmp_path)
+        row = ex2.execute("i", "Range(t=9, 2018-03-01T00:00, 2018-04-01T00:00)")[0]
+        assert [int(c) for c in row.columns()] == [1]
+        store2.close()
+
+    def test_node_id_stable(self, tmp_path):
+        h, store, _ = make(tmp_path)
+        nid = store.node_id()
+        assert store.node_id() == nid
+        h2, store2, _ = make(tmp_path)
+        assert store2.node_id() == nid
+
+
+class TestFragmentFile:
+    def test_snapshot_compacts_oplog(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment("i", "f", "standard", 0)
+        path = str(tmp_path / "frag")
+        store = FragmentFile(frag, path, snapshot_queue=None)
+        store.open()
+        for c in range(50):
+            frag.set_bit(1, c)
+        size_with_ops = os.path.getsize(path)
+        store.snapshot()
+        assert os.path.getsize(path) < size_with_ops
+        assert store.op_n == 0
+        # reload
+        frag2 = Fragment("i", "f", "standard", 0)
+        store2 = FragmentFile(frag2, path)
+        store2.open()
+        np.testing.assert_array_equal(frag2.row_columns(1), np.arange(50))
+
+    def test_auto_snapshot_over_max_opn(self, tmp_path, monkeypatch):
+        import pilosa_tpu.storage.fragmentfile as ff
+        from pilosa_tpu.core.fragment import Fragment
+
+        monkeypatch.setattr(ff, "MAX_OP_N", 20)
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        for c in range(30):
+            frag.set_bit(2, c)
+        assert store.op_n <= 20  # snapshot reset it at least once
+
+    def test_huge_row_id_persist_raises(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        with pytest.raises(ValueError):
+            frag.set_bit(2**60, 0)
+
+    def test_mutex_ops_logged(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        frag.set_bit(1, 7)
+        frag.set_mutex(2, 7)
+        frag2 = Fragment()
+        store2 = FragmentFile(frag2, str(tmp_path / "frag"))
+        store2.open()
+        assert not frag2.get_bit(1, 7)
+        assert frag2.get_bit(2, 7)
+
+    def test_reference_sample_view_decodes(self):
+        # the reference's own sample fragment file (testdata/sample_view/0);
+        # decoded read-only (never attach a FragmentFile to the read-only
+        # reference mount)
+        data = open("/root/reference/testdata/sample_view/0", "rb").read()
+        positions = roaring.deserialize(data)
+        assert len(positions) == 35001
+        # round-trip through our serializer preserves the bit set
+        np.testing.assert_array_equal(
+            roaring.deserialize(roaring.serialize(positions)), positions
+        )
+
+
+class TestStorageReviewRegressions:
+    def test_huge_row_rejected_before_mutation(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        with pytest.raises(ValueError):
+            frag.set_bit(2**60, 3)
+        # memory must NOT have been mutated
+        assert not frag.get_bit(2**60, 3)
+        assert frag.total_count() == 0
+
+    def test_set_row_words_snapshot_mid_log(self, tmp_path, monkeypatch):
+        # snapshot triggered while logging a row replacement must not lose
+        # the added bits on replay
+        import pilosa_tpu.storage.fragmentfile as ff
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.ops import bitops as bo
+
+        monkeypatch.setattr(ff, "MAX_OP_N", 1)
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        frag.set_bit(1, 5)
+        words = bo.pack_columns(np.array([6]), frag.n_words)
+        frag.set_row_words(1, words)
+        frag2 = Fragment()
+        FragmentFile(frag2, str(tmp_path / "frag")).open()
+        np.testing.assert_array_equal(frag2.row_columns(1), [6])
+
+    def test_bsi_value_is_one_batch_record(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment()
+        path = str(tmp_path / "frag")
+        store = FragmentFile(frag, path)
+        store.open()
+        base_size = os.path.getsize(path)
+        frag.set_value(3, 16, 0xAAAA)
+        data = open(path, "rb").read()
+        ops = list(roaring.decode_ops(data, base_size))
+        # one add-batch record (clears of unset planes produce nothing)
+        assert len(ops) == 1
+        assert ops[0][0] == roaring.OP_ADD_BATCH
