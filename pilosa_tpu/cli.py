@@ -1,0 +1,263 @@
+"""Command-line interface (reference: cmd/ cobra tree + ctl/ subcommands).
+
+    pilosa-tpu server            run a node (reference ctl/server)
+    pilosa-tpu import            CSV/value import into a running node
+    pilosa-tpu export            CSV export from a running node
+    pilosa-tpu check             offline integrity check of fragment files
+                                 (reference ctl/check.go:47-133)
+    pilosa-tpu inspect           print container stats of fragment files
+                                 (reference ctl/inspect.go)
+    pilosa-tpu generate-config   emit default config
+                                 (reference ctl/generate_config.go)
+
+Config precedence mirrors the reference (cmd/root.go): flags > env
+(PILOSA_TPU_*) > config file (JSON or TOML) > defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+DEFAULT_CONFIG = {
+    "data-dir": "~/.pilosa-tpu",
+    "bind": "localhost:10101",
+    "long-query-time": 0.0,
+    "cluster": {"replicas": 1, "coordinator": True, "hosts": []},
+    "anti-entropy": {"interval": 600},
+    "metric": {"service": "none", "poll-interval": 60},
+    "tracing": {"enabled": False},
+}
+
+
+def _load_config(path: str | None) -> dict:
+    cfg = json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
+    if path:
+        with open(path, "rb") as f:
+            if path.endswith(".toml"):
+                import tomllib
+
+                file_cfg = tomllib.load(f)
+            else:
+                file_cfg = json.load(f)
+        _deep_update(cfg, file_cfg)
+    env_map = {
+        "PILOSA_TPU_DATA_DIR": ("data-dir",),
+        "PILOSA_TPU_BIND": ("bind",),
+        "PILOSA_TPU_LONG_QUERY_TIME": ("long-query-time",),
+    }
+    for env, keys in env_map.items():
+        if env in os.environ:
+            d = cfg
+            for k in keys[:-1]:
+                d = d[k]
+            d[keys[-1]] = os.environ[env]
+    return cfg
+
+
+def _deep_update(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_update(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _ensure_backend() -> None:
+    """Fall back to the CPU backend when the accelerator can't initialize
+    (e.g. another process holds the chip grant) — a degraded node beats a
+    node whose every query 500s."""
+    import jax
+
+    try:
+        jax.devices()
+    except Exception as e:
+        print(f"warning: accelerator unavailable ({e}); using CPU backend")
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
+def cmd_server(args) -> int:
+    _ensure_backend()
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http import Server
+    from pilosa_tpu.storage.disk import HolderStore
+
+    cfg = _load_config(args.config)
+    data_dir = os.path.expanduser(args.data_dir or cfg["data-dir"])
+    bind = args.bind or cfg["bind"]
+    host, _, port = bind.rpartition(":")
+    host = host or "localhost"
+
+    holder = Holder()
+    store = HolderStore(holder, data_dir)
+    store.open()
+    api = API(holder, store)
+    server = Server(
+        api, host=host, port=int(port), long_query_time=float(cfg["long-query-time"])
+    )
+    print(f"pilosa-tpu server listening on http://{host}:{server.port}, data dir {data_dir}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+def _http(args, method: str, path: str, body: bytes | None = None, content_type="application/json"):
+    url = f"http://{args.host}{path}"
+    req = urllib.request.Request(url, data=body, method=method)
+    req.add_header("Content-Type", content_type)
+    with urllib.request.urlopen(req) as resp:
+        return resp.read()
+
+
+def cmd_import(args) -> int:
+    """CSV import (reference ctl/import.go:82-378): lines of row,col or
+    col,value with --field-type int."""
+    rows, cols, values, timestamps = [], [], [], []
+    has_ts = False
+    for path in args.files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split(",")
+                if args.int_values:
+                    cols.append(int(parts[0]))
+                    values.append(int(parts[1]))
+                else:
+                    rows.append(parts[0] if args.row_keys else int(parts[0]))
+                    cols.append(parts[1] if args.col_keys else int(parts[1]))
+                    if len(parts) > 2:
+                        has_ts = True
+                        timestamps.append(parts[2])
+                    else:
+                        timestamps.append(None)
+    if args.int_values:
+        payload = {"columnIDs": cols, "values": values}
+    else:
+        payload = {
+            ("rowKeys" if args.row_keys else "rowIDs"): rows,
+            ("columnKeys" if args.col_keys else "columnIDs"): cols,
+        }
+        if has_ts:
+            payload["timestamps"] = timestamps
+    if args.clear:
+        payload["clear"] = True
+    _http(
+        args,
+        "POST",
+        f"/index/{args.index}/field/{args.field}/import",
+        json.dumps(payload).encode(),
+    )
+    total = len(cols)
+    print(f"imported {total} records into {args.index}/{args.field}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    data = _http(args, "GET", f"/export?index={args.index}&field={args.field}")
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    out.write(data.decode())
+    if out is not sys.stdout:
+        out.close()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline integrity check of roaring fragment files (reference
+    ctl/check.go:47-133)."""
+    from pilosa_tpu.storage import roaring
+
+    failed = 0
+    for path in args.files:
+        try:
+            with open(path, "rb") as f:
+                positions = roaring.deserialize(f.read())
+            print(f"{path}: OK ({len(positions)} bits)")
+        except Exception as e:
+            print(f"{path}: FAILED: {e}")
+            failed += 1
+    return 1 if failed else 0
+
+
+def cmd_inspect(args) -> int:
+    """Container statistics of a fragment file (reference ctl/inspect.go)."""
+    import numpy as np
+
+    from pilosa_tpu.storage import roaring
+
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        positions = roaring.deserialize(data)
+        keys = positions >> np.uint64(16) if len(positions) else positions
+        n_containers = len(np.unique(keys)) if len(positions) else 0
+        print(f"{path}:")
+        print(f"  bits: {len(positions)}")
+        print(f"  containers: {n_containers}")
+        if len(positions):
+            print(f"  min position: {positions.min()}")
+            print(f"  max position: {positions.max()}")
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    print(json.dumps(DEFAULT_CONFIG, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pilosa-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("server", help="run a pilosa-tpu node")
+    ps.add_argument("-d", "--data-dir", default=None)
+    ps.add_argument("-b", "--bind", default=None)
+    ps.add_argument("-c", "--config", default=None)
+    ps.set_defaults(fn=cmd_server)
+
+    for name, fn in [("import", cmd_import)]:
+        pi = sub.add_parser(name, help="bulk import CSV")
+        pi.add_argument("--host", default="localhost:10101")
+        pi.add_argument("-i", "--index", required=True)
+        pi.add_argument("-f", "--field", required=True)
+        pi.add_argument("--int-values", action="store_true", help="col,value CSV for int fields")
+        pi.add_argument("--row-keys", action="store_true")
+        pi.add_argument("--col-keys", action="store_true")
+        pi.add_argument("--clear", action="store_true")
+        pi.add_argument("files", nargs="+")
+        pi.set_defaults(fn=fn)
+
+    pe = sub.add_parser("export", help="export a field as CSV")
+    pe.add_argument("--host", default="localhost:10101")
+    pe.add_argument("-i", "--index", required=True)
+    pe.add_argument("-f", "--field", required=True)
+    pe.add_argument("-o", "--output", default="-")
+    pe.set_defaults(fn=cmd_export)
+
+    pc = sub.add_parser("check", help="verify fragment files")
+    pc.add_argument("files", nargs="+")
+    pc.set_defaults(fn=cmd_check)
+
+    pn = sub.add_parser("inspect", help="inspect fragment files")
+    pn.add_argument("files", nargs="+")
+    pn.set_defaults(fn=cmd_inspect)
+
+    pg = sub.add_parser("generate-config", help="print default config")
+    pg.set_defaults(fn=cmd_generate_config)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Node runtime: the programmatic API (reference api.go), HTTP transport
+(reference http/handler.go), and server composition root (reference
+server.go)."""

@@ -1,0 +1,345 @@
+"""Programmatic API surface (reference: api.go, 1414 LoC).
+
+Every HTTP route lands here. Methods are **state-gated** exactly like the
+reference (api.go:100-124 validAPIMethods + apimethod_string.go): during
+STARTING only status-ish methods work; during RESIZING only fragment
+transfer and abort. A single node sits in NORMAL.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core import timequantum
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec.executor import ExecuteError, Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.storage import roaring
+from pilosa_tpu.storage.disk import HolderStore
+
+# Cluster states (reference cluster.go:46-51).
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+# Methods valid in non-NORMAL states (reference api.go:100-124).
+_STARTING_METHODS = {
+    "Status", "Info", "Version", "Schema", "ClusterMessage", "Hosts",
+}
+_RESIZING_METHODS = {
+    "Status", "Info", "Version", "ClusterMessage", "Hosts",
+    "FragmentData", "ResizeAbort",
+}
+
+
+class ApiError(Exception):
+    def __init__(self, msg: str, code: int = 400):
+        super().__init__(msg)
+        self.code = code
+
+
+class NotFoundError(ApiError):
+    def __init__(self, msg: str):
+        super().__init__(msg, 404)
+
+
+class ConflictError(ApiError):
+    def __init__(self, msg: str):
+        super().__init__(msg, 409)
+
+
+class API:
+    """reference api.go:74 NewAPI."""
+
+    def __init__(
+        self,
+        holder: Holder | None = None,
+        store: HolderStore | None = None,
+        cluster=None,
+    ):
+        self.holder = holder or Holder()
+        self.store = store
+        self.cluster = cluster
+        translator = store.translator if store is not None else None
+        self.executor = Executor(self.holder, translator=translator)
+        self._lock = threading.RLock()
+        self.state = STATE_NORMAL
+
+    # -- state gating (reference api.go:100-124) ---------------------------
+
+    def _validate(self, method: str) -> None:
+        if self.state == STATE_NORMAL or self.state == STATE_DEGRADED:
+            return
+        allowed = (
+            _STARTING_METHODS if self.state == STATE_STARTING else _RESIZING_METHODS
+        )
+        if method not in allowed:
+            raise ApiError(
+                f"api method {method} not allowed in state {self.state}", 503
+            )
+
+    # -- queries ------------------------------------------------------------
+
+    def query(self, index: str, pql: str, shards: list[int] | None = None) -> dict:
+        """reference api.go:134 Query."""
+        self._validate("Query")
+        from pilosa_tpu.pql import ParseError
+
+        try:
+            results = self.executor.execute(index, pql, shards=shards)
+        except (ExecuteError, ParseError, ValueError, TypeError) as e:
+            raise ApiError(str(e))
+        return {"results": result_to_json(results)}
+
+    # -- schema CRUD (reference api.go:161-495) -----------------------------
+
+    def schema(self) -> dict:
+        self._validate("Schema")
+        return {"indexes": self.holder.schema()}
+
+    def apply_schema(self, schema: dict) -> None:
+        self._validate("ApplySchema")
+        self.holder.apply_schema(schema.get("indexes", []))
+        self._sync()
+
+    def create_index(self, name: str, options: dict | None = None) -> dict:
+        self._validate("CreateIndex")
+        options = options or {}
+        with self._lock:
+            if self.holder.index(name) is not None:
+                raise ConflictError("index already exists")
+            try:
+                idx = self.holder.create_index(
+                    name,
+                    keys=options.get("keys", False),
+                    track_existence=options.get("trackExistence", True),
+                )
+            except ValueError as e:
+                raise ApiError(str(e))
+        self._sync()
+        return idx.to_dict()
+
+    def delete_index(self, name: str) -> None:
+        self._validate("DeleteIndex")
+        if not self.holder.delete_index(name):
+            raise NotFoundError("index not found")
+        if self.store is not None:
+            self.store.delete_index_dir(name)
+
+    def index_info(self, name: str) -> dict:
+        self._validate("Index")
+        idx = self.holder.index(name)
+        if idx is None:
+            raise NotFoundError("index not found")
+        return idx.to_dict()
+
+    def create_field(self, index: str, field: str, options: dict | None = None) -> dict:
+        self._validate("CreateField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError("index not found")
+        if idx.field(field) is not None:
+            raise ConflictError("field already exists")
+        try:
+            f = idx.create_field(field, FieldOptions.from_dict(options or {}))
+        except ValueError as e:
+            raise ApiError(str(e))
+        self._sync()
+        return f.to_dict()
+
+    def delete_field(self, index: str, field: str) -> None:
+        self._validate("DeleteField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError("index not found")
+        if not idx.delete_field(field):
+            raise NotFoundError("field not found")
+        if self.store is not None:
+            self.store.delete_field_dir(index, field)
+
+    def field_info(self, index: str, field: str) -> dict:
+        self._validate("Field")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError("field not found")
+        return f.to_dict()
+
+    # -- imports (reference api.go:919-1112 Import/ImportValue,
+    #    :367-427 ImportRoaring) --------------------------------------------
+
+    def import_bits(self, index: str, field: str, req: dict) -> None:
+        """JSON bulk import: rowIDs/rowKeys + columnIDs/columnKeys
+        (+ timestamps), or columnIDs/columnKeys + values for int fields."""
+        self._validate("Import")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError("index not found")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError("field not found")
+        translator = self.executor.translator
+
+        cols = req.get("columnIDs")
+        if cols is None:
+            keys = req.get("columnKeys")
+            if keys is None:
+                raise ApiError("columnIDs or columnKeys required")
+            if not idx.keys:
+                raise ApiError("columnKeys given but index does not use keys")
+            cols = translator.translate_keys(index, "", keys)
+        cols = np.asarray(cols, dtype=np.uint64)
+
+        if "values" in req:
+            if not f.is_bsi():
+                raise ApiError(f"field {field!r} is not an int field")
+            values = np.asarray(req["values"], dtype=np.int64)
+            if len(values) != len(cols):
+                raise ApiError("columns/values length mismatch")
+            lo, hi = int(values.min()) if len(values) else 0, int(values.max()) if len(values) else 0
+            if len(values) and (lo < f.options.min or hi > f.options.max):
+                raise ApiError("value out of field range")
+            f.import_values(cols, values, clear=req.get("clear", False))
+        else:
+            rows = req.get("rowIDs")
+            if rows is None:
+                keys = req.get("rowKeys")
+                if keys is None:
+                    raise ApiError("rowIDs or rowKeys required")
+                if not f.keys:
+                    raise ApiError("rowKeys given but field does not use keys")
+                rows = translator.translate_keys(index, field, keys)
+            if len(rows) != len(cols):
+                raise ApiError("rows/columns length mismatch")
+            timestamps = req.get("timestamps")
+            ts = None
+            if timestamps is not None:
+                ts = [
+                    timequantum.parse_time(t) if t else None for t in timestamps
+                ]
+            f.import_bits(
+                np.asarray(rows, dtype=np.uint64),
+                cols,
+                timestamps=ts,
+                clear=req.get("clear", False),
+            )
+        ef = idx.existence_field()
+        if ef is not None and not req.get("clear", False):
+            ef.import_bits(np.zeros(len(cols), dtype=np.uint64), cols)
+
+    def import_roaring(self, index: str, field: str, shard: int, data: bytes, clear: bool = False, view: str = VIEW_STANDARD) -> dict:
+        """Binary roaring import: the highest-throughput ingest path
+        (reference api.go:367-427; call stack SURVEY §3.4)."""
+        self._validate("ImportRoaring")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError("field not found")
+        try:
+            positions = roaring.deserialize(data)
+        except roaring.RoaringError as e:
+            raise ApiError(f"bad roaring payload: {e}")
+        width = f.n_words * 32
+        rows = positions // np.uint64(width)
+        cols_local = (positions % np.uint64(width)).astype(np.int64)
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        changed = frag.import_bits(rows, cols_local, clear=clear)
+        idx = self.holder.index(index)
+        ef = idx.existence_field() if idx is not None else None
+        if ef is not None and not clear and len(cols_local):
+            ef.import_bits(
+                np.zeros(len(cols_local), dtype=np.uint64),
+                cols_local.astype(np.uint64) + np.uint64(shard) * np.uint64(width),
+            )
+        return {"changed": int(changed)}
+
+    # -- export (reference api.go:499-573 ExportCSV) ------------------------
+
+    def export_csv(self, index: str, field: str, shard: int | None = None) -> str:
+        self._validate("ExportCSV")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError("field not found")
+        v = f.view(VIEW_STANDARD)
+        out = io.StringIO()
+        translator = self.executor.translator
+        idx = self.holder.index(index)
+        if v is not None:
+            shards = sorted(v.fragments) if shard is None else [shard]
+            for s in shards:
+                frag = v.fragment(s)
+                if frag is None:
+                    continue
+                width = frag.shard_width
+                for row in frag.row_ids():
+                    cols = frag.row_columns(row)
+                    for c in cols:
+                        col = int(c) + s * width
+                        if f.keys:
+                            rk = translator.translate_id(index, field, row)
+                            row_out = rk
+                        else:
+                            row_out = row
+                        if idx is not None and idx.keys:
+                            col_out = translator.translate_id(index, "", col)
+                        else:
+                            col_out = col
+                        out.write(f"{row_out},{col_out}\n")
+        return out.getvalue()
+
+    # -- cluster/info (reference api.go:1114-1342) --------------------------
+
+    def status(self) -> dict:
+        self._validate("Status")
+        nodes = (
+            self.cluster.nodes_info()
+            if self.cluster is not None
+            else [{"id": self._node_id(), "uri": "", "isCoordinator": True, "state": "READY"}]
+        )
+        return {"state": self.state, "nodes": nodes, "localID": self._node_id()}
+
+    def info(self) -> dict:
+        self._validate("Info")
+        from pilosa_tpu.shardwidth import SHARD_WIDTH_EXP
+
+        return {"shardWidth": 1 << SHARD_WIDTH_EXP, "shardWidthExp": SHARD_WIDTH_EXP}
+
+    def version(self) -> dict:
+        return {"version": __version__}
+
+    def hosts(self) -> list[dict]:
+        self._validate("Hosts")
+        return self.status()["nodes"]
+
+    def shards_max(self) -> dict:
+        """reference api.go MaxShards /internal/shards/max."""
+        return {
+            "standard": {
+                name: max(idx.available_shards(), default=0)
+                for name, idx in self.holder.indexes.items()
+            }
+        }
+
+    def translate_keys(self, index: str, field: str | None, keys: list[str]) -> list[int]:
+        self._validate("TranslateKeys")
+        return self.executor.translator.translate_keys(index, field or "", keys)
+
+    def _node_id(self) -> str:
+        if self.store is not None:
+            return self.store.node_id()
+        return "local"
+
+    def _sync(self) -> None:
+        if self.store is not None:
+            self.store.sync()
+
+    def close(self) -> None:
+        if self.store is not None:
+            self.store.close()

@@ -1,0 +1,240 @@
+"""HTTP transport (reference: http/handler.go, 1702 LoC).
+
+Route surface mirrors the reference's public router (handler.go:276-314):
+
+    GET  /                               -> redirect note
+    GET  /version /status /info /schema
+    POST /schema
+    POST /index/{index}                  create index
+    GET  /index/{index}
+    DELETE /index/{index}
+    POST /index/{index}/query            PQL body -> {"results": [...]}
+    POST /index/{index}/field/{field}    create field
+    GET/DELETE /index/{index}/field/{field}
+    POST /index/{index}/field/{field}/import           JSON batch
+    POST /index/{index}/field/{field}/import-roaring/{shard}  binary roaring
+    GET  /export?index=&field=           CSV
+    GET  /internal/shards/max
+    POST /internal/translate/keys
+
+JSON replaces the reference's protobuf codec (encoding/proto) as this
+framework's wire format; the roaring import payload is binary-compatible
+with reference clients. Long-running queries log at a threshold like the
+reference's long-query-time (handler.go:246-248).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.server.api import API, ApiError
+
+logger = logging.getLogger("pilosa_tpu.http")
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/$"), "root"),
+    ("GET", re.compile(r"^/version$"), "version"),
+    ("GET", re.compile(r"^/status$"), "status"),
+    ("GET", re.compile(r"^/info$"), "info"),
+    ("GET", re.compile(r"^/schema$"), "get_schema"),
+    ("POST", re.compile(r"^/schema$"), "post_schema"),
+    ("GET", re.compile(r"^/export$"), "export"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import$"), "import_"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/(?P<shard>\d+)$"), "import_roaring"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)$"), "create_field"),
+    ("GET", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)$"), "get_field"),
+    ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)$"), "delete_field"),
+    ("POST", re.compile(r"^/index/(?P<index>[^/]+)$"), "create_index"),
+    ("GET", re.compile(r"^/index/(?P<index>[^/]+)$"), "get_index"),
+    ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)$"), "delete_index"),
+    ("GET", re.compile(r"^/internal/shards/max$"), "shards_max"),
+    ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
+]
+
+
+class Handler(BaseHTTPRequestHandler):
+    api: API = None  # set by make_server
+    long_query_time: float = 0.0
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug(fmt, *args)
+
+    def _send(self, code: int, body: bytes, content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, (json.dumps(obj) + "\n").encode())
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _json_body(self) -> dict:
+        raw = self._body()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid json: {e}")
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        self.query_params = parse_qs(parsed.query)
+        for m, rx, name in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(parsed.path)
+            if match:
+                t0 = time.monotonic()
+                try:
+                    getattr(self, "r_" + name)(**match.groupdict())
+                except ApiError as e:
+                    self._send_json(e.code, {"error": str(e)})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # internal error
+                    logger.exception("internal error")
+                    self._send_json(500, {"error": f"internal: {e}"})
+                finally:
+                    elapsed = time.monotonic() - t0
+                    if self.long_query_time and elapsed > self.long_query_time:
+                        logger.warning(
+                            "long query %.3fs: %s %s", elapsed, method, self.path
+                        )
+                return
+        self._send_json(404, {"error": "not found"})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- routes -------------------------------------------------------------
+
+    def r_root(self):
+        self._send_json(200, {"message": "pilosa-tpu server. See /schema, /status, /index/{index}/query."})
+
+    def r_version(self):
+        self._send_json(200, self.api.version())
+
+    def r_status(self):
+        self._send_json(200, self.api.status())
+
+    def r_info(self):
+        self._send_json(200, self.api.info())
+
+    def r_get_schema(self):
+        self._send_json(200, self.api.schema())
+
+    def r_post_schema(self):
+        self.api.apply_schema(self._json_body())
+        self._send_json(200, {})
+
+    def r_query(self, index: str):
+        pql = self._body().decode()
+        shards = None
+        if "shards" in self.query_params:
+            shards = [
+                int(s)
+                for part in self.query_params["shards"]
+                for s in part.split(",")
+                if s
+            ]
+        self._send_json(200, self.api.query(index, pql, shards=shards))
+
+    def r_create_index(self, index: str):
+        body = self._json_body()
+        self._send_json(200, self.api.create_index(index, body.get("options", {})))
+
+    def r_get_index(self, index: str):
+        self._send_json(200, self.api.index_info(index))
+
+    def r_delete_index(self, index: str):
+        self.api.delete_index(index)
+        self._send_json(200, {})
+
+    def r_create_field(self, index: str, field: str):
+        body = self._json_body()
+        self._send_json(200, self.api.create_field(index, field, body.get("options", {})))
+
+    def r_get_field(self, index: str, field: str):
+        self._send_json(200, self.api.field_info(index, field))
+
+    def r_delete_field(self, index: str, field: str):
+        self.api.delete_field(index, field)
+        self._send_json(200, {})
+
+    def r_import_(self, index: str, field: str):
+        self.api.import_bits(index, field, self._json_body())
+        self._send_json(200, {})
+
+    def r_import_roaring(self, index: str, field: str, shard: str):
+        clear = self.query_params.get("clear", ["false"])[0] == "true"
+        result = self.api.import_roaring(
+            index, field, int(shard), self._body(), clear=clear
+        )
+        self._send_json(200, result)
+
+    def r_export(self):
+        index = self.query_params.get("index", [None])[0]
+        field = self.query_params.get("field", [None])[0]
+        if not index or not field:
+            raise ApiError("index and field query params required")
+        shard = self.query_params.get("shard", [None])[0]
+        csv = self.api.export_csv(index, field, int(shard) if shard else None)
+        self._send(200, csv.encode(), content_type="text/csv")
+
+    def r_shards_max(self):
+        self._send_json(200, self.api.shards_max())
+
+    def r_translate_keys(self):
+        body = self._json_body()
+        ids = self.api.translate_keys(
+            body.get("index", ""), body.get("field", ""), body.get("keys", [])
+        )
+        self._send_json(200, {"ids": ids})
+
+
+class Server:
+    """HTTP server wrapper: bind, serve in background, close."""
+
+    def __init__(self, api: API, host: str = "localhost", port: int = 10101, long_query_time: float = 0.0):
+        handler = type("BoundHandler", (Handler,), {"api": api, "long_query_time": long_query_time})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.api = api
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.api.close()

@@ -183,9 +183,22 @@ class HolderStore:
                 with open(os.path.join(field_dir, ".attrs.json"), "w") as f:
                     json.dump(field.row_attrs.to_dict(), f)
 
+    def _detach_stores(self, match) -> None:
+        """Close + drop FragmentFile stores whose fragment matches, so
+        deleted indexes/fields leak neither fds nor _stores entries."""
+        kept = []
+        for store in self._stores:
+            if match(store.fragment):
+                store.close()
+                store.fragment.store = None
+            else:
+                kept.append(store)
+        self._stores = kept
+
     def delete_index_dir(self, name: str) -> None:
         import shutil
 
+        self._detach_stores(lambda frag: frag.index == name)
         d = self._index_dir(name)
         if os.path.isdir(d):
             shutil.rmtree(d)
@@ -193,6 +206,9 @@ class HolderStore:
     def delete_field_dir(self, index: str, name: str) -> None:
         import shutil
 
+        self._detach_stores(
+            lambda frag: frag.index == index and frag.field == name
+        )
         d = self._field_dir(index, name)
         if os.path.isdir(d):
             shutil.rmtree(d)

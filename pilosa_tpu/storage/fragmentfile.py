@@ -61,7 +61,7 @@ class FragmentFile:
             with open(self.path, "rb") as f:
                 data = f.read()
             if data:
-                positions = roaring.deserialize(data)
+                positions, self.op_n = roaring.deserialize_with_opcount(data)
                 width = self.fragment.shard_width
                 rows_arr = positions // np.uint64(width)
                 cols_arr = (positions % np.uint64(width)).astype(np.int64)
@@ -78,11 +78,8 @@ class FragmentFile:
     # -- op append ----------------------------------------------------------
 
     def _positions(self, row: int, mask: np.ndarray) -> np.ndarray:
+        self.check_row(row)
         width = self.fragment.shard_width
-        if row > (2**64 - 1) // width:
-            raise ValueError(
-                f"row id {row} too large to persist at shard width {width}"
-            )
         return np.uint64(row) * np.uint64(width) + bitops.unpack_columns(mask)
 
     def _append(self, record: bytes, count: int) -> None:
@@ -91,6 +88,7 @@ class FragmentFile:
                 self._fh = open(self.path, "ab")
             self._fh.write(record)
             self._fh.flush()
+            os.fsync(self._fh.fileno())  # durable against power loss
             self.op_n += count
         if self.op_n > MAX_OP_N:
             self.request_snapshot()
@@ -188,10 +186,7 @@ class FragmentFile:
         width = self.fragment.shard_width
         parts = []
         for row, words in sorted(self.fragment.to_host_rows().items()):
-            if row > (2**64 - 1) // width:
-                raise ValueError(
-                    f"row id {row} too large to persist at shard width {width}"
-                )
+            self.check_row(row)
             parts.append(
                 np.uint64(row) * np.uint64(width) + bitops.unpack_columns(words)
             )
@@ -235,12 +230,20 @@ class SnapshotQueue:
             store.snapshot()
 
     def _run(self) -> None:
+        import logging
+
         while True:
             store = self._queue.get()
             if store is None:
                 return
             try:
                 store.snapshot()
+            except Exception:
+                # e.g. the fragment's directory was deleted mid-flight;
+                # never let a failed snapshot kill the worker
+                logging.getLogger("pilosa_tpu.storage").exception(
+                    "snapshot failed for %s", store.path
+                )
             finally:
                 with self._lock:
                     self._pending.discard(id(store))

@@ -163,6 +163,13 @@ def deserialize(data: bytes) -> np.ndarray:
     """Roaring file bytes (either format) -> sorted uint64 positions,
     with any trailing Pilosa op log applied (reference
     roaring.go:1562-1654 unmarshalPilosaRoaring)."""
+    return deserialize_with_opcount(data)[0]
+
+
+def deserialize_with_opcount(data: bytes) -> tuple[np.ndarray, int]:
+    """(positions, op-log record bit count) — the count restores a
+    reopened fragment's MaxOpN snapshot trigger (the reference counts ops
+    while replaying on open)."""
     if len(data) < 8:
         raise RoaringError("file too short")
     (cookie,) = struct.unpack_from("<I", data, 0)
@@ -170,7 +177,7 @@ def deserialize(data: bytes) -> np.ndarray:
     if magic == MAGIC:
         return _deserialize_pilosa(data)
     if magic in (COOKIE_NO_RUN, COOKIE_RUN):
-        return _deserialize_official(data)
+        return _deserialize_official(data), 0
     raise RoaringError(f"bad magic {magic}")
 
 
@@ -202,8 +209,7 @@ def _deserialize_pilosa(data: bytes) -> np.ndarray:
         np.concatenate(parts) if parts else np.empty(0, dtype=np.uint64)
     )
     # op log section
-    positions = _apply_ops(positions, data, data_end)
-    return positions
+    return _apply_ops(positions, data, data_end)
 
 
 def _deserialize_official(data: bytes) -> np.ndarray:
@@ -240,16 +246,10 @@ def _deserialize_official(data: bytes) -> np.ndarray:
     parts = []
     cur = pos
     for i, (key, card) in enumerate(zip(keys, cards)):
-        if run_bitset[i]:
-            ctype = CONTAINER_RUN
-        elif card <= ARRAY_MAX_SIZE:
-            ctype = CONTAINER_ARRAY
-        else:
-            ctype = CONTAINER_BITMAP
         off = offsets[i] if offsets is not None else cur
-        vals, end = _container_positions(key, ctype, card, data, off)
-        # official run containers have no inline count; runs are [start,len]
         if run_bitset[i]:
+            # official run containers: [start, len] pairs (the pilosa
+            # variant uses [start, last]), decoded directly here
             (run_count,) = struct.unpack_from("<H", data, off)
             runs = np.frombuffer(
                 data, dtype="<u2", count=run_count * 2, offset=off + 2
@@ -262,6 +262,9 @@ def _deserialize_official(data: bytes) -> np.ndarray:
                 np.concatenate(parts2) if parts2 else np.empty(0, np.uint64)
             )
             end = off + 2 + 4 * run_count
+        else:
+            ctype = CONTAINER_ARRAY if card <= ARRAY_MAX_SIZE else CONTAINER_BITMAP
+            vals, end = _container_positions(key, ctype, card, data, off)
         parts.append(vals)
         cur = end
     return (
@@ -332,23 +335,30 @@ def decode_ops(data: bytes, start: int):
             return
 
 
-def _apply_ops(positions: np.ndarray, data: bytes, start: int) -> np.ndarray:
+def _apply_ops(positions: np.ndarray, data: bytes, start: int) -> tuple[np.ndarray, int]:
     current: set | None = None
-    for op_type, payload, _ in decode_ops(data, start):
+    op_count = 0
+    for op_type, payload, op_n in decode_ops(data, start):
         if current is None:
             current = set(positions.tolist())
         if op_type == OP_ADD:
             current.add(payload)
+            op_count += 1
         elif op_type == OP_REMOVE:
             current.discard(payload)
+            op_count += 1
         elif op_type == OP_ADD_BATCH:
             current.update(payload.tolist())
+            op_count += len(payload)
         elif op_type == OP_REMOVE_BATCH:
             current.difference_update(payload.tolist())
+            op_count += len(payload)
         elif op_type == OP_ADD_ROARING:
             current.update(deserialize(payload).tolist())
+            op_count += op_n
         elif op_type == OP_REMOVE_ROARING:
             current.difference_update(deserialize(payload).tolist())
+            op_count += op_n
     if current is None:
-        return positions
-    return np.array(sorted(current), dtype=np.uint64)
+        return positions, 0
+    return np.array(sorted(current), dtype=np.uint64), op_count

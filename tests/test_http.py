@@ -1,0 +1,218 @@
+"""HTTP/transport tests against a live in-process server (the reference's
+http/handler_test.go + client_test.go pattern over test.MustRunCluster)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.storage import roaring
+from pilosa_tpu.storage.disk import HolderStore
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    holder = Holder()
+    store = HolderStore(holder, str(tmp_path / "data"))
+    store.open()
+    api = API(holder, store)
+    server = Server(api, port=0)  # port 0: auto-bind (reference test/pilosa.go:54-83)
+    server.serve_background()
+    yield server
+    server.close()
+
+
+def call(srv, method, path, body=None, content_type="application/json", raw=False):
+    url = f"http://localhost:{srv.port}{path}"
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", content_type)
+    with urllib.request.urlopen(req) as resp:
+        payload = resp.read()
+        return payload if raw else (json.loads(payload) if payload.strip() else {})
+
+
+def test_version_status_info(srv):
+    assert "version" in call(srv, "GET", "/version")
+    st = call(srv, "GET", "/status")
+    assert st["state"] == "NORMAL"
+    assert len(st["nodes"]) == 1
+    assert call(srv, "GET", "/info")["shardWidth"] == SHARD_WIDTH
+
+
+def test_index_field_lifecycle(srv):
+    call(srv, "POST", "/index/myidx", {"options": {}})
+    call(srv, "POST", "/index/myidx/field/myfield", {"options": {"type": "set"}})
+    schema = call(srv, "GET", "/schema")
+    names = [i["name"] for i in schema["indexes"]]
+    assert "myidx" in names
+    info = call(srv, "GET", "/index/myidx/field/myfield")
+    assert info["options"]["type"] == "set"
+    # conflict
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/myidx")
+    assert e.value.code == 409
+    call(srv, "DELETE", "/index/myidx/field/myfield")
+    call(srv, "DELETE", "/index/myidx")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "GET", "/index/myidx")
+    assert e.value.code == 404
+
+
+def test_query_roundtrip(srv):
+    call(srv, "POST", "/index/i")
+    call(srv, "POST", "/index/i/field/f")
+    r = call(srv, "POST", "/index/i/query", b"Set(10, f=1)", content_type="text/plain")
+    assert r == {"results": [True]}
+    r = call(srv, "POST", "/index/i/query", b"Row(f=1)")
+    assert r["results"][0]["columns"] == [10]
+    r = call(srv, "POST", "/index/i/query", b"Count(Row(f=1))")
+    assert r["results"] == [1]
+
+
+def test_query_error_shapes(srv):
+    call(srv, "POST", "/index/i")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/i/query", b"Row(nofield=1)")
+    assert e.value.code == 400
+    body = json.loads(e.value.read())
+    assert "error" in body
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/nope/query", b"Row(f=1)")
+    assert e.value.code == 400
+
+
+def test_json_import_and_export(srv):
+    call(srv, "POST", "/index/i")
+    call(srv, "POST", "/index/i/field/f")
+    call(
+        srv,
+        "POST",
+        "/index/i/field/f/import",
+        {"rowIDs": [1, 1, 2], "columnIDs": [5, SHARD_WIDTH + 6, 7]},
+    )
+    r = call(srv, "POST", "/index/i/query", b"Row(f=1)")
+    assert r["results"][0]["columns"] == [5, SHARD_WIDTH + 6]
+    csv = call(srv, "GET", "/export?index=i&field=f", raw=True).decode()
+    lines = set(csv.strip().splitlines())
+    assert lines == {"1,5", f"1,{SHARD_WIDTH + 6}", "2,7"}
+
+
+def test_import_values(srv):
+    call(srv, "POST", "/index/i")
+    call(
+        srv,
+        "POST",
+        "/index/i/field/v",
+        {"options": {"type": "int", "min": -10, "max": 100}},
+    )
+    call(srv, "POST", "/index/i/field/v/import", {"columnIDs": [1, 2], "values": [7, -3]})
+    r = call(srv, "POST", "/index/i/query", b"Sum(field=v)")
+    assert r["results"][0] == {"value": 4, "count": 2}
+
+
+def test_import_roaring_binary(srv):
+    call(srv, "POST", "/index/i")
+    call(srv, "POST", "/index/i/field/f")
+    # row 3, cols {1, 9}: positions 3*width + {1, 9}
+    width = SHARD_WIDTH
+    payload = roaring.serialize(
+        np.array([3 * width + 1, 3 * width + 9], dtype=np.uint64)
+    )
+    r = call(
+        srv,
+        "POST",
+        "/index/i/field/f/import-roaring/0",
+        payload,
+        content_type="application/octet-stream",
+    )
+    assert r == {"changed": 2}
+    q = call(srv, "POST", "/index/i/query", b"Row(f=3)")
+    assert q["results"][0]["columns"] == [1, 9]
+
+
+def test_keys_over_http(srv):
+    call(srv, "POST", "/index/ki", {"options": {"keys": True}})
+    call(srv, "POST", "/index/ki/field/f", {"options": {"keys": True}})
+    call(srv, "POST", "/index/ki/query", b'Set("a", f="x")')
+    r = call(srv, "POST", "/index/ki/query", b'Row(f="x")')
+    assert r["results"][0]["keys"] == ["a"]
+    ids = call(
+        srv, "POST", "/internal/translate/keys", {"index": "ki", "field": "", "keys": ["a"]}
+    )
+    assert ids == {"ids": [1]}
+
+
+def test_shards_max(srv):
+    call(srv, "POST", "/index/i")
+    call(srv, "POST", "/index/i/field/f")
+    call(srv, "POST", "/index/i/query", f"Set({SHARD_WIDTH * 2 + 1}, f=1)".encode())
+    r = call(srv, "GET", "/internal/shards/max")
+    assert r["standard"]["i"] == 2
+
+
+def test_persistence_across_server_restart(tmp_path):
+    holder = Holder()
+    store = HolderStore(holder, str(tmp_path / "data"))
+    store.open()
+    api = API(holder, store)
+    server = Server(api, port=0)
+    server.serve_background()
+    call(server, "POST", "/index/i")
+    call(server, "POST", "/index/i/field/f")
+    call(server, "POST", "/index/i/query", b"Set(42, f=7)")
+    port = server.port
+    server.close()
+
+    holder2 = Holder()
+    store2 = HolderStore(holder2, str(tmp_path / "data"))
+    store2.open()
+    api2 = API(holder2, store2)
+    server2 = Server(api2, port=0)
+    server2.serve_background()
+    try:
+        r = call(server2, "POST", "/index/i/query", b"Row(f=7)")
+        assert r["results"][0]["columns"] == [42]
+    finally:
+        server2.close()
+
+
+def test_state_gating(srv):
+    from pilosa_tpu.server.api import STATE_STARTING
+
+    srv.api.state = STATE_STARTING
+    # status still works
+    assert call(srv, "GET", "/status")["state"] == "STARTING"
+    # queries gated
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/i/query", b"Row(f=1)")
+    assert e.value.code == 503
+    srv.api.state = "NORMAL"
+
+
+def test_cli_check_and_inspect(tmp_path, capsys):
+    from pilosa_tpu import cli
+
+    good = tmp_path / "good"
+    good.write_bytes(roaring.serialize(np.array([1, 2, 3], dtype=np.uint64)))
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00bogus\x00\x00\x00\x00")
+    assert cli.main(["check", str(good)]) == 0
+    assert cli.main(["check", str(bad)]) == 1
+    assert cli.main(["inspect", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "bits: 3" in out
+
+
+def test_cli_generate_config(capsys):
+    from pilosa_tpu import cli
+
+    assert cli.main(["generate-config"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["bind"] == "localhost:10101"

@@ -206,3 +206,58 @@ class TestStorageReviewRegressions:
         # one add-batch record (clears of unset planes produce nothing)
         assert len(ops) == 1
         assert ops[0][0] == roaring.OP_ADD_BATCH
+
+
+class TestStorageReviewRegressions2:
+    def test_opn_restored_on_reopen(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        frag = Fragment()
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        for c in range(7):
+            frag.set_bit(1, c)
+        assert store.op_n == 7
+        store.close()
+        frag2 = Fragment()
+        store2 = FragmentFile(frag2, str(tmp_path / "frag"))
+        store2.open()
+        assert store2.op_n == 7  # restored, so MaxOpN still triggers
+
+    def test_snapshot_worker_survives_failure(self, tmp_path):
+        import shutil
+
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.storage.fragmentfile import SnapshotQueue
+
+        q = SnapshotQueue(workers=1)
+        d = tmp_path / "gone"
+        d.mkdir()
+        frag = Fragment()
+        store = FragmentFile(frag, str(d / "frag"))
+        store.open()
+        frag.set_bit(1, 1)
+        store.close()
+        shutil.rmtree(d)  # snapshot will fail: dir removed
+        q.enqueue(store)
+        q.await_all()  # must not hang
+        # worker still alive: a good store snapshot still runs
+        frag2 = Fragment()
+        store2 = FragmentFile(frag2, str(tmp_path / "ok"), q)
+        store2.open()
+        frag2.set_bit(1, 1)
+        q.enqueue(store2)
+        q.await_all()
+        assert store2.op_n == 0
+        q.stop()
+
+    def test_delete_index_detaches_stores(self, tmp_path):
+        h, store, ex = make(tmp_path)
+        h.create_index("i").create_field("f")
+        ex.execute("i", "Set(1, f=1)")
+        frag = h.fragment("i", "f", "standard", 0)
+        assert frag.store is not None
+        n_before = len(store._stores)
+        store.delete_index_dir("i")
+        assert frag.store is None
+        assert len(store._stores) < n_before
