@@ -1,0 +1,42 @@
+"""Cluster / distribution layer (reference: cluster.go, broadcast.go,
+gossip/, http/client.go).
+
+The reference distributes data by hashing (index, shard) onto one of 256
+partitions and jump-hashing partitions onto nodes, with ReplicaN
+consecutive ring nodes as replicas (cluster.go:847-934). Queries fan out
+shard-wise to owning nodes and reduce at the coordinator of the query
+(executor.go:2454-2611). This package keeps that control-plane design —
+placement, replication, typed broadcast messages, state machine — while
+the TPU build's data plane within one host is a pjit mesh (see
+pilosa_tpu.parallel): a "node" here is one host process driving its own
+chip slice, and node↔node traffic rides HTTP/JSON instead of the
+reference's HTTP/protobuf.
+"""
+
+from pilosa_tpu.cluster.hash import jump_hash, partition_hash
+from pilosa_tpu.cluster.topology import Node, Topology
+from pilosa_tpu.cluster.cluster import (
+    Cluster,
+    STATE_STARTING,
+    STATE_NORMAL,
+    STATE_DEGRADED,
+    STATE_RESIZING,
+)
+from pilosa_tpu.cluster.broadcast import Broadcaster, NopBroadcaster
+from pilosa_tpu.cluster.client import InternalClient, NopInternalClient
+
+__all__ = [
+    "jump_hash",
+    "partition_hash",
+    "Node",
+    "Topology",
+    "Cluster",
+    "Broadcaster",
+    "NopBroadcaster",
+    "InternalClient",
+    "NopInternalClient",
+    "STATE_STARTING",
+    "STATE_NORMAL",
+    "STATE_DEGRADED",
+    "STATE_RESIZING",
+]

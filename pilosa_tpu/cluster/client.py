@@ -1,0 +1,199 @@
+"""Internal node↔node HTTP client (reference: client.go InternalClient
+interface :47-76, http/client.go implementation).
+
+All node↔node data-plane traffic goes through this client: query
+fan-out, import forwarding, fragment block retrieval for anti-entropy,
+whole-fragment streaming for resize, and control messages. JSON replaces
+the reference's protobuf codec.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any
+
+
+class ClientError(Exception):
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _do(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: bytes | None = None,
+        content_type: str = "application/json",
+    ) -> bytes:
+        req = urllib.request.Request(
+            uri.rstrip("/") + path, data=body, method=method
+        )
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise ClientError(f"{method} {path}: {e.code} {detail}", e.code) from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ClientError(f"{method} {path}: {e}") from e
+
+    def _json(self, method: str, uri: str, path: str, obj: Any = None) -> Any:
+        body = None if obj is None else json.dumps(obj).encode()
+        out = self._do(method, uri, path, body)
+        return json.loads(out) if out else None
+
+    # -- queries (reference http/client.go QueryNode) -----------------------
+
+    def query_node(
+        self, uri: str, index: str, query: str, shards: list[int]
+    ) -> list[Any]:
+        """Execute on a remote node against its shard list; returns wire
+        results (reference executor.go:2416-2434 remoteExec)."""
+        resp = self._json(
+            "POST",
+            uri,
+            f"/index/{index}/query",
+            {"query": query, "shards": shards, "remote": True},
+        )
+        return resp["wireResults"]
+
+    # -- imports (reference http/client.go Import/ImportRoaring) ------------
+
+    def import_bits(self, uri: str, index: str, field: str, req: dict) -> None:
+        self._json(
+            "POST", uri, f"/index/{index}/field/{field}/import", dict(req, remote=True)
+        )
+
+    def import_roaring(
+        self, uri: str, index: str, field: str, shard: int, data: bytes,
+        clear: bool = False, view: str = "standard",
+    ) -> dict:
+        q = f"?remote=true&clear={'true' if clear else 'false'}&view={view}"
+        out = self._do(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import-roaring/{shard}{q}",
+            data,
+            content_type="application/octet-stream",
+        )
+        return json.loads(out) if out else {}
+
+    # -- fragment data (anti-entropy + resize) ------------------------------
+
+    def fragment_blocks(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> list[dict]:
+        """Block checksums (reference http/client.go FragmentBlocks)."""
+        resp = self._json(
+            "GET",
+            uri,
+            f"/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+        )
+        return resp["blocks"]
+
+    def block_data(
+        self, uri: str, index: str, field: str, view: str, shard: int, block: int
+    ) -> dict:
+        """Row/col pairs of one block (reference BlockData)."""
+        return self._json(
+            "POST",
+            uri,
+            "/internal/fragment/block/data",
+            {"index": index, "field": field, "view": view,
+             "shard": shard, "block": block},
+        )
+
+    def retrieve_fragment(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> bytes:
+        """Whole-fragment snapshot stream for resize (reference
+        RetrieveShardFromURI http/client.go)."""
+        return self._do(
+            "GET",
+            uri,
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+        )
+
+    # -- control plane ------------------------------------------------------
+
+    def send_message(self, uri: str, msg: dict) -> None:
+        self._json("POST", uri, "/internal/cluster/message", msg)
+
+    def status(self, uri: str) -> dict:
+        return self._json("GET", uri, "/status")
+
+    def version(self, uri: str) -> dict:
+        """Liveness double-check (reference confirmNodeDown
+        cluster.go:1699-1726 probes /version)."""
+        return self._json("GET", uri, "/version")
+
+    def translate_keys(
+        self, uri: str, index: str, field: str | None, keys: list[str]
+    ) -> list[int]:
+        return self._json(
+            "POST",
+            uri,
+            "/internal/translate/keys",
+            {"index": index, "field": field, "keys": keys},
+        )["ids"]
+
+    def translate_ids(
+        self, uri: str, index: str, field: str | None, ids: list[int]
+    ) -> list[str]:
+        return self._json(
+            "POST",
+            uri,
+            "/internal/translate/ids",
+            {"index": index, "field": field, "ids": ids},
+        )["keys"]
+
+
+class NopInternalClient:
+    """reference client.go:103 nopInternalClient."""
+
+    def query_node(self, uri, index, query, shards):
+        return []
+
+    def import_bits(self, uri, index, field, req):
+        pass
+
+    def import_roaring(self, uri, index, field, shard, data, clear=False, view="standard"):
+        pass
+
+    def fragment_blocks(self, uri, index, field, view, shard):
+        return []
+
+    def block_data(self, uri, index, field, view, shard, block):
+        return {"rows": [], "cols": []}
+
+    def retrieve_fragment(self, uri, index, field, view, shard):
+        return b""
+
+    def send_message(self, uri, msg):
+        pass
+
+    def status(self, uri):
+        return {}
+
+    def version(self, uri):
+        return {}
+
+    def translate_keys(self, uri, index, field, keys):
+        return []
+
+    def translate_ids(self, uri, index, field, ids):
+        return []

@@ -1,0 +1,352 @@
+"""Distributed query execution: per-shard map-reduce over cluster nodes
+(reference: executor.go:2416-2611 mapReduce/mapper/remoteExec).
+
+The coordinator of a query (whichever node received it):
+
+1. translates keys → ids once (reference executor.go:116-209),
+2. fans each call out shard-wise — local shards run on this node's
+   executor, remote shard groups travel as re-serialized PQL with
+   ``remote=true`` + the target's shard list (reference remoteExec),
+3. reduces streaming per-call results (union of disjoint-shard bitmap
+   segments, count sums, TopN/GroupBy merges),
+4. retries a failed node's shards against the remaining replicas
+   (reference executor.go:2495-2506), and
+5. translates ids → keys in the final results.
+
+Point writes (Set/Clear/attrs) are applied synchronously on EVERY
+replica of the target shard (reference executor.go:2140-2207); row/attr
+writes with no shard affinity broadcast to all nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pilosa_tpu import pql
+from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.cluster.cluster import Cluster
+from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
+from pilosa_tpu.cluster.wire import decode_results
+from pilosa_tpu.exec.executor import ExecuteError, Executor, IndexNotFoundError
+from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
+from pilosa_tpu.pql.ast import Call
+
+# Calls whose result is a Row bitmap (reference executeBitmapCallShard
+# dispatch, executor.go:653-680).
+_BITMAP_CALLS = {
+    "Row", "Range", "Difference", "Intersect", "Union", "Xor", "Not", "Shift",
+}
+# Point writes fanned to all replicas of one shard.
+_POINT_WRITES = {"Set", "Clear", "SetColumnAttrs"}
+# Writes with no single-shard affinity, broadcast to every node.
+_BROADCAST_WRITES = {"SetRowAttrs"}
+# Shard-distributed writes that must hit every replica of every shard.
+_SHARD_WRITES = {"ClearRow", "Store"}
+
+
+class NoAvailableReplicaError(ExecuteError):
+    pass
+
+
+class DistributedExecutor:
+    """Cluster-aware executor wrapping the single-node Executor."""
+
+    def __init__(self, holder, cluster: Cluster, client, translator=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client
+        self.local = Executor(holder, translator=translator)
+
+    @property
+    def _single(self) -> bool:
+        return len(self.cluster.nodes) <= 1
+
+    # -- entry points -------------------------------------------------------
+
+    def execute(
+        self,
+        index_name: str,
+        query: str | pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any]:
+        if self._single:
+            return self.local.execute(index_name, query, shards=shards)
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise IndexNotFoundError(f"index not found: {index_name}")
+        q = pql.parse(query) if isinstance(query, str) else query
+        results = []
+        for call in q.calls:
+            tcall = call.clone()
+            self.local._translate_call(idx, tcall)
+            results.append(self._execute_call(index_name, idx, tcall, shards))
+        return [
+            self.local._translate_result(idx, c, r)
+            for c, r in zip(q.calls, results)
+        ]
+
+    def execute_remote(
+        self, index_name: str, query: str | pql.Query, shards: list[int] | None
+    ) -> list[Any]:
+        """Mapped-node entry (reference Remote:true re-entry,
+        executor.go:2520-2555): keys were translated at the coordinator,
+        so run raw calls over our shard list and return raw results."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise IndexNotFoundError(f"index not found: {index_name}")
+        q = pql.parse(query) if isinstance(query, str) else query
+        return [self.local._execute_call(idx, c, shards) for c in q.calls]
+
+    # -- per-call routing ---------------------------------------------------
+
+    def _execute_call(
+        self, index_name: str, idx, call: Call, shards: list[int] | None
+    ) -> Any:
+        if call.name in _POINT_WRITES:
+            return self._execute_point_write(index_name, idx, call)
+        if call.name in _BROADCAST_WRITES:
+            return self._execute_broadcast_write(index_name, idx, call)
+        all_shards = self.local._shards_for(idx, shards)
+        if call.name in _SHARD_WRITES:
+            return self._execute_shard_write(index_name, idx, call, all_shards)
+        return self._map_reduce(index_name, idx, call, all_shards)
+
+    def _shard_of_write(self, call: Call) -> int:
+        col, ok = call.uint_arg("_col")
+        if not ok:
+            raise ExecuteError(f"{call.name}() column argument required")
+        return col // (self.holder.n_words * 32)
+
+    def _execute_point_write(self, index_name: str, idx, call: Call) -> Any:
+        """Apply on every replica of the shard (reference
+        executor.go:2140-2207 executeSetBitField)."""
+        shard = self._shard_of_write(call)
+        result = None
+        for node in self.cluster.shard_nodes(index_name, shard):
+            if node.id == self.cluster.node_id:
+                result = self.local._execute_call(idx, call, [shard])
+            else:
+                wire = self.client.query_node(
+                    node.uri, index_name, str(call), [shard]
+                )
+                remote = decode_results(wire)[0]
+                result = remote if result is None else (result or remote)
+        return result
+
+    def _execute_broadcast_write(self, index_name: str, idx, call: Call) -> Any:
+        result = None
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node_id:
+                result = self.local._execute_call(idx, call, None)
+            else:
+                self.client.query_node(node.uri, index_name, str(call), [])
+        return result
+
+    def _execute_shard_write(
+        self, index_name: str, idx, call: Call, shards: list[int]
+    ) -> Any:
+        """ClearRow/Store on every replica of every shard so replicas
+        never diverge (the reference reaches the same end state via
+        mapReduce + anti-entropy repair)."""
+        by_replica: dict[str, list[int]] = {}
+        for s in shards:
+            for node in self.cluster.shard_nodes(index_name, s):
+                by_replica.setdefault(node.id, []).append(s)
+        changed = False
+        for node_id, nshards in by_replica.items():
+            node = self.cluster.node(node_id)
+            if node_id == self.cluster.node_id:
+                changed |= bool(self.local._execute_call(idx, call, nshards))
+            else:
+                wire = self.client.query_node(
+                    node.uri, index_name, str(call), nshards
+                )
+                changed |= bool(decode_results(wire)[0])
+        return changed
+
+    # -- map-reduce (reference executor.go:2454-2611) -----------------------
+
+    def _map_reduce(
+        self, index_name: str, idx, call: Call, shards: list[int]
+    ) -> Any:
+        pql_text = str(call)
+        bad_nodes: set[str] = set()
+        partials: list[Any] = []
+        pending = list(shards)
+        while pending:
+            groups = self._group_by_live_owner(index_name, pending, bad_nodes)
+            pending = []
+            for node_id, nshards in groups.items():
+                node = self.cluster.node(node_id)
+                if node_id == self.cluster.node_id:
+                    partials.append(self.local._execute_call(idx, call, nshards))
+                    continue
+                try:
+                    wire = self.client.query_node(
+                        node.uri, index_name, pql_text, nshards
+                    )
+                    partials.append(decode_results(wire)[0])
+                except ClientError:
+                    # Failover: re-map this node's shards onto remaining
+                    # replicas (reference executor.go:2495-2506).
+                    bad_nodes.add(node_id)
+                    pending.extend(nshards)
+        if not partials:
+            partials = [self.local._execute_call(idx, call, [])]
+        return _reduce(call, partials)
+
+    def _group_by_live_owner(
+        self, index_name: str, shards: list[int], bad_nodes: set[str]
+    ) -> dict[str, list[int]]:
+        groups: dict[str, list[int]] = {}
+        for s in shards:
+            owner = None
+            for node in self.cluster.shard_nodes(index_name, s):
+                if node.id in bad_nodes or node.state == NODE_STATE_DOWN:
+                    continue
+                owner = node
+                break
+            if owner is None:
+                raise NoAvailableReplicaError(
+                    f"no available replica for shard {s} of {index_name!r}"
+                )
+            groups.setdefault(owner.id, []).append(s)
+        return groups
+
+
+# -- reduce functions (reference executor.go per-call reduceFns) ------------
+
+
+def _reduce(call: Call, partials: list[Any]) -> Any:
+    name = call.name
+    if name == "Options" and call.children:
+        name = call.children[0].name
+    fn = _REDUCERS.get(name)
+    if fn is None:
+        if name in _BITMAP_CALLS:
+            fn = _reduce_rows_union
+        else:
+            raise ExecuteError(f"no reducer for call {call.name!r}")
+    return fn(call, partials)
+
+
+def _reduce_rows_union(call: Call, partials: list[Any]) -> Row:
+    out = Row({})
+    for p in partials:
+        if p is not None:
+            out = out.union(p)
+    return out
+
+
+def _reduce_count(call: Call, partials: list[Any]) -> int:
+    return sum(int(p) for p in partials if p is not None)
+
+
+def _reduce_sum(call: Call, partials: list[Any]) -> ValCount:
+    out = ValCount()
+    for p in partials:
+        if p is not None:
+            out = ValCount(out.value + p.value, out.count + p.count)
+    return out
+
+
+def _reduce_min_max(maximal: bool) -> Callable:
+    def fn(call: Call, partials: list[Any]) -> ValCount:
+        out = None
+        for p in partials:
+            if p is None or p.count == 0:
+                continue
+            if out is None or (p.value > out.value) == maximal:
+                out = ValCount(p.value, p.count)
+            elif p.value == out.value:
+                out = ValCount(out.value, out.count + p.count)
+        return out or ValCount()
+
+    return fn
+
+
+def _reduce_min_max_row(maximal: bool) -> Callable:
+    def fn(call: Call, partials: list[Any]) -> Pair:
+        out = None
+        for p in partials:
+            if p is None or p.count == 0:
+                continue
+            if out is None or (p.id > out.id) == maximal:
+                out = Pair(id=p.id, key=p.key, count=p.count)
+            elif p.id == out.id:
+                out = Pair(id=out.id, key=out.key, count=out.count + p.count)
+        return out or Pair()
+
+    return fn
+
+
+def _reduce_topn(call: Call, partials: list[Any]) -> list[Pair]:
+    counts: dict[int, int] = {}
+    for p in partials:
+        for pair in p or []:
+            counts[pair.id] = counts.get(pair.id, 0) + pair.count
+    n, _ = call.uint_arg("n")
+    pairs = sorted(
+        (Pair(id=i, count=c) for i, c in counts.items()),
+        key=lambda pr: (-pr.count, pr.id),
+    )
+    if n:
+        pairs = pairs[:n]
+    return pairs
+
+
+def _reduce_rows_call(call: Call, partials: list[Any]) -> RowIdentifiers:
+    ids: set[int] = set()
+    for p in partials:
+        if p is not None:
+            ids.update(p.rows)
+    rows = sorted(ids)
+    limit, ok = call.uint_arg("limit")
+    if ok and limit is not None:
+        rows = rows[:limit]
+    return RowIdentifiers(rows=rows)
+
+
+def _reduce_groupby(call: Call, partials: list[Any]) -> list[GroupCount]:
+    merged: dict[tuple, GroupCount] = {}
+    for p in partials:
+        for gc in p or []:
+            key = tuple((g.field, g.row_id, g.row_key) for g in gc.group)
+            if key in merged:
+                merged[key] = GroupCount(gc.group, merged[key].count + gc.count)
+            else:
+                merged[key] = GroupCount(gc.group, gc.count)
+    out = sorted(
+        merged.values(), key=lambda gc: [g.row_id for g in gc.group]
+    )
+    limit, ok = call.uint_arg("limit")
+    if ok and limit is not None:
+        out = out[:limit]
+    return [gc for gc in out if gc.count > 0]
+
+
+def _reduce_bool_or(call: Call, partials: list[Any]) -> bool:
+    return any(bool(p) for p in partials if p is not None)
+
+
+def _reduce_first(call: Call, partials: list[Any]) -> Any:
+    return partials[0] if partials else None
+
+
+_REDUCERS: dict[str, Callable] = {
+    "Count": _reduce_count,
+    "Sum": _reduce_sum,
+    "Min": _reduce_min_max(False),
+    "Max": _reduce_min_max(True),
+    "MinRow": _reduce_min_max_row(False),
+    "MaxRow": _reduce_min_max_row(True),
+    "TopN": _reduce_topn,
+    "Rows": _reduce_rows_call,
+    "GroupBy": _reduce_groupby,
+    "ClearRow": _reduce_bool_or,
+    "Store": _reduce_bool_or,
+    "Set": _reduce_bool_or,
+    "Clear": _reduce_bool_or,
+    "SetRowAttrs": _reduce_first,
+    "SetColumnAttrs": _reduce_first,
+}

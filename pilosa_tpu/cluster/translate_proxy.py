@@ -1,0 +1,67 @@
+"""Primary/replica key translation (reference: translate.go:91-97,
+cluster.go:1971-1996, holder.go:643-650).
+
+The reference designates one node as translation primary; replicas
+stream its append-only log and refuse new-key writes
+(ErrTranslateStoreReadOnly, translate.go:52). Here non-primary nodes
+forward new-key allocation to the primary over HTTP and cache the
+returned mappings in their local store, so id→key result translation is
+local after first use and replicas never allocate conflicting ids.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.core.translate import TranslateStore
+
+
+class PrimaryTranslateStore:
+    """TranslateStore facade routing allocation to the cluster's
+    translation primary."""
+
+    def __init__(self, local: TranslateStore, cluster, client):
+        self.local = local
+        self.cluster = cluster
+        self.client = client
+
+    def _is_primary(self) -> bool:
+        primary = self.cluster.translate_primary()
+        return (
+            primary is None
+            or primary.id == self.cluster.node_id
+            or len(self.cluster.nodes) <= 1
+        )
+
+    def translate_keys(self, index: str, field: str, keys: list[str], create: bool = True) -> list[int]:
+        if self._is_primary():
+            return self.local.translate_keys(index, field, keys, create=create)
+        # Serve fully-cached batches locally; otherwise ask the primary.
+        cached = self.local.translate_keys(index, field, keys, create=False)
+        if all(i != 0 for i in cached):
+            return cached
+        primary = self.cluster.translate_primary()
+        ids = self.client.translate_keys(primary.uri, index, field or "", keys)
+        self.local.set_mapping(index, field, keys, ids)
+        return ids
+
+    def translate_ids(self, index: str, field: str, id_list: list[int]) -> list[str]:
+        out = self.local.translate_ids(index, field, id_list)
+        if all(k != "" for k in out) or self._is_primary():
+            return out
+        primary = self.cluster.translate_primary()
+        keys = self.client.translate_ids(primary.uri, index, field or "", id_list)
+        # set_mapping drops ""-keyed entries, so unknown ids are re-asked
+        # rather than cached as poison.
+        self.local.set_mapping(index, field, keys, id_list)
+        return keys
+
+    def translate_key(self, index: str, field: str, key: str, create: bool = True) -> int:
+        return self.translate_keys(index, field, [key], create=create)[0]
+
+    def translate_id(self, index: str, field: str, id_: int) -> str:
+        return self.translate_ids(index, field, [id_])[0]
+
+    def to_dict(self) -> dict:
+        return self.local.to_dict()
+
+    def load_dict(self, d: dict) -> None:
+        self.local.load_dict(d)

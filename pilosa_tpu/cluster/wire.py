@@ -1,0 +1,103 @@
+"""Wire encoding of query results for node↔node fan-out (reference:
+encoding/proto/proto.go QueryResult union, internal/public.proto:72-82).
+
+The reference tags each result with a type id and protobuf-encodes it;
+this build tags each result with a type string and JSON-encodes it. Row
+bitmaps travel as raw little-endian uint32 words per shard segment
+(base64), which keeps the coordinator's reduce step a pure bitwise merge
+— ids materialize only at the API edge, like the reference.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pilosa_tpu.exec.result import (
+    FieldRow,
+    GroupCount,
+    Pair,
+    Row,
+    RowIdentifiers,
+    ValCount,
+)
+
+
+def encode_result(result: Any) -> Any:
+    if isinstance(result, Row):
+        return {
+            "type": "row",
+            "segments": {
+                str(shard): base64.b64encode(
+                    np.asarray(seg, dtype=np.uint32).tobytes()
+                ).decode()
+                for shard, seg in result.segments.items()
+            },
+        }
+    if isinstance(result, ValCount):
+        return {"type": "valcount", "value": result.value, "count": result.count}
+    if isinstance(result, Pair):
+        return {"type": "pair", "id": result.id, "key": result.key, "count": result.count}
+    if isinstance(result, RowIdentifiers):
+        return {"type": "rowids", "rows": result.rows, "keys": result.keys}
+    if isinstance(result, GroupCount):
+        return {
+            "type": "groupcount",
+            "group": [
+                {"field": g.field, "rowID": g.row_id, "rowKey": g.row_key}
+                for g in result.group
+            ],
+            "count": result.count,
+        }
+    if isinstance(result, list):
+        return {"type": "list", "items": [encode_result(r) for r in result]}
+    if isinstance(result, (bool, int, str)) or result is None:
+        return {"type": "scalar", "value": result}
+    if isinstance(result, np.integer):
+        return {"type": "scalar", "value": int(result)}
+    raise TypeError(f"unencodable wire result: {type(result)!r}")
+
+
+def decode_result(obj: Any) -> Any:
+    t = obj["type"]
+    if t == "row":
+        segments = {}
+        for shard, b in obj["segments"].items():
+            words = np.frombuffer(base64.b64decode(b), dtype=np.uint32)
+            segments[int(shard)] = jnp.asarray(words)
+        return Row(segments)
+    if t == "valcount":
+        return ValCount(value=obj["value"], count=obj["count"])
+    if t == "pair":
+        return Pair(id=obj.get("id") or 0, key=obj.get("key"), count=obj["count"])
+    if t == "rowids":
+        return RowIdentifiers(rows=obj.get("rows") or [], keys=obj.get("keys"))
+    if t == "groupcount":
+        return GroupCount(
+            group=[
+                FieldRow(
+                    field=g["field"],
+                    row_id=g.get("rowID") or 0,
+                    row_key=g.get("rowKey"),
+                )
+                for g in obj["group"]
+            ],
+            count=obj["count"],
+        )
+    if t == "list":
+        return [decode_result(r) for r in obj["items"]]
+    if t == "scalar":
+        return obj["value"]
+    raise TypeError(f"unknown wire result type: {t!r}")
+
+
+def encode_results(results: list[Any]) -> list[Any]:
+    return [encode_result(r) for r in results]
+
+
+def decode_results(results: list[Any]) -> list[Any]:
+    return [decode_result(r) for r in results]

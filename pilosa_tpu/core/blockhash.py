@@ -1,0 +1,22 @@
+"""Block checksum hashing for anti-entropy (reference fragment.go:81,
+1760-1839: 100-row blocks, xxhash64 over row/col pairs).
+
+blake2b (8-byte digest, stdlib) stands in for xxhash64 — the checksum
+only needs to be deterministic across nodes and cheap; it never leaves
+the cluster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def new_hash():
+    return hashlib.blake2b(digest_size=8)
+
+
+def add_row(h, row: int, words: np.ndarray) -> None:
+    h.update(row.to_bytes(8, "little"))
+    h.update(np.ascontiguousarray(words, dtype=np.uint32).tobytes())

@@ -127,6 +127,9 @@ class Field:
         self.row_attrs = AttrStore()
         self.on_create_view = None  # cluster broadcast hook (field.go:795-815)
         self.on_create_fragment = None
+        # Shards held by OTHER nodes, learned via create-shard broadcasts
+        # (reference field.go:263-345 remoteAvailableShards).
+        self.remote_available_shards: set[int] = set()
 
         o = self.options
         if o.field_type == FIELD_TYPE_INT:
@@ -187,12 +190,18 @@ class Field:
         return view_name_bsi(self.name)
 
     def available_shards(self) -> set[int]:
-        """Union of shards across views (reference field.go
-        remoteAvailableShards + local)."""
-        shards: set[int] = set()
+        """Union of local shards across views plus shards known to exist
+        on other nodes (reference field.go remoteAvailableShards + local)."""
+        shards: set[int] = set(self.remote_available_shards)
         for v in self.views.values():
             shards |= v.available_shards()
         return shards
+
+    def add_remote_available_shards(self, shards) -> None:
+        """Merge shards learned from a create-shard broadcast or node
+        status exchange (reference field.go:331-345 AddRemoteAvailableShards)."""
+        with self._lock:
+            self.remote_available_shards |= set(shards)
 
     # -- set/time/mutex/bool writes (reference field.go:886-968) -----------
 

@@ -48,6 +48,9 @@ BSI_EXISTS_BIT = 0
 BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
 
+# Rows per anti-entropy checksum block (reference fragment.go:81).
+HASH_BLOCK_SIZE = 100
+
 _MIN_CAPACITY = 8
 
 
@@ -490,3 +493,54 @@ class Fragment:
     def total_count(self) -> int:
         with self._lock:
             return bitops.popcount_host(self._host)
+
+    def all_positions(self) -> np.ndarray:
+        """Sorted absolute bit positions row*width + col of every set bit
+        (the whole-fragment interchange payload, reference
+        fragment.go:2424-2594 WriteTo)."""
+        with self._lock:
+            parts = []
+            width = np.uint64(self.shard_width)
+            for row in sorted(self._slot_of):
+                cols = bitops.unpack_columns(self._host[self._slot_of[row]])
+                if len(cols):
+                    parts.append(cols.astype(np.uint64) + np.uint64(row) * width)
+            if not parts:
+                return np.array([], dtype=np.uint64)
+            return np.concatenate(parts)
+
+    # -- anti-entropy blocks (reference fragment.go:1760-1991) --------------
+
+    def blocks(self) -> list[dict]:
+        """Checksums of HashBlockSize-row blocks; blocks with no bits are
+        omitted (reference fragment.go Blocks/blockChecksum)."""
+        from pilosa_tpu.core import blockhash
+
+        with self._lock:
+            by_block: dict[int, list[int]] = {}
+            for row in sorted(self._slot_of):
+                if self._host[self._slot_of[row]].any():
+                    by_block.setdefault(row // HASH_BLOCK_SIZE, []).append(row)
+            out = []
+            for block in sorted(by_block):
+                h = blockhash.new_hash()
+                for row in by_block[block]:
+                    blockhash.add_row(h, row, self._host[self._slot_of[row]])
+                out.append({"id": block, "checksum": h.hexdigest()})
+            return out
+
+    def block_data(self, block: int) -> tuple[list[int], list[int]]:
+        """(rows, cols) pairs of every set bit in a block, local
+        coordinates, row-major (reference fragment.go blockData)."""
+        with self._lock:
+            rows_out: list[int] = []
+            cols_out: list[int] = []
+            lo = block * HASH_BLOCK_SIZE
+            for row in range(lo, lo + HASH_BLOCK_SIZE):
+                slot = self._slot_of.get(row)
+                if slot is None:
+                    continue
+                cols = bitops.unpack_columns(self._host[slot])
+                rows_out.extend([row] * len(cols))
+                cols_out.extend(int(c) for c in cols)
+            return rows_out, cols_out

@@ -72,6 +72,20 @@ class TranslateStore:
     def translate_id(self, index: str, field: str, id_: int) -> str:
         return self.translate_ids(index, field, [id_])[0]
 
+    def set_mapping(self, index: str, field: str, keys: list[str], id_list: list[int]) -> None:
+        """Install key->id pairs allocated elsewhere (replica-side cache of
+        the primary's log, reference translate.go replication :91-97).
+        Bypasses read_only — this IS the replication write path."""
+        with self._lock:
+            ids, key_list = self._space(index, field)
+            for k, i in zip(keys, id_list):
+                if i <= 0 or k == "":
+                    continue
+                while len(key_list) < i:
+                    key_list.append("")
+                key_list[i - 1] = k
+                ids[k] = i
+
     # -- persistence --------------------------------------------------------
 
     def to_dict(self) -> dict:

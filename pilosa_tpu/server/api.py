@@ -9,6 +9,7 @@ transfer and abort. A single node sits in NORMAL.
 from __future__ import annotations
 
 import io
+import logging
 import threading
 from typing import Any
 
@@ -23,6 +24,8 @@ from pilosa_tpu.exec.executor import ExecuteError, Executor
 from pilosa_tpu.exec.result import result_to_json
 from pilosa_tpu.storage import roaring
 from pilosa_tpu.storage.disk import HolderStore
+
+logger = logging.getLogger("pilosa_tpu.api")
 
 # Cluster states (reference cluster.go:46-51).
 STATE_STARTING = "STARTING"
@@ -64,14 +67,54 @@ class API:
         holder: Holder | None = None,
         store: HolderStore | None = None,
         cluster=None,
+        client=None,
+        broadcaster=None,
     ):
         self.holder = holder or Holder()
         self.store = store
         self.cluster = cluster
+        self.client = client
+        self.broadcaster = broadcaster
         translator = store.translator if store is not None else None
         self.executor = Executor(self.holder, translator=translator)
+        # Cluster-aware execution path (reference executor.go mapReduce);
+        # collapses to the local executor on a single node.
+        self.dist = None
+        if cluster is not None and client is not None:
+            from pilosa_tpu.cluster.dist import DistributedExecutor
+
+            self.dist = DistributedExecutor(
+                self.holder, cluster, client, translator=translator
+            )
         self._lock = threading.RLock()
-        self.state = STATE_NORMAL
+        self._state = STATE_NORMAL
+
+    @property
+    def state(self) -> str:
+        if self.cluster is not None and hasattr(self.cluster, "state"):
+            return self.cluster.state
+        return self._state
+
+    @state.setter
+    def state(self, value: str) -> None:
+        if self.cluster is not None and hasattr(self.cluster, "set_state"):
+            self.cluster.set_state(value)
+        else:
+            self._state = value
+
+    def _broadcast(self, msg: dict) -> None:
+        """Best-effort control-plane fan-out: a peer that misses a schema
+        message re-converges via the schema sync pass of anti-entropy
+        (the reference re-exchanges full NodeStatus incl. schema on every
+        gossip push/pull, gossip.go:321-357). Raising here instead would
+        leave the already-committed local mutation un-broadcast forever,
+        since a client retry hits ConflictError before re-broadcasting."""
+        if self.broadcaster is None:
+            return
+        try:
+            self.broadcaster.send_sync(msg)
+        except Exception as e:
+            logger.warning("broadcast %s failed: %s", msg.get("type"), e)
 
     # -- state gating (reference api.go:100-124) ---------------------------
 
@@ -88,13 +131,30 @@ class API:
 
     # -- queries ------------------------------------------------------------
 
-    def query(self, index: str, pql: str, shards: list[int] | None = None) -> dict:
-        """reference api.go:134 Query."""
+    def query(
+        self,
+        index: str,
+        pql: str,
+        shards: list[int] | None = None,
+        remote: bool = False,
+    ) -> dict:
+        """reference api.go:134 Query. ``remote=True`` marks a mapped
+        sub-query from another node's coordinator (reference Remote:true
+        QueryRequest): keys arrive pre-translated, results return in wire
+        encoding for the caller's reduce step."""
         self._validate("Query")
         from pilosa_tpu.pql import ParseError
 
         try:
-            results = self.executor.execute(index, pql, shards=shards)
+            if remote and self.dist is not None:
+                from pilosa_tpu.cluster.wire import encode_results
+
+                results = self.dist.execute_remote(index, pql, shards)
+                return {"wireResults": encode_results(results)}
+            if self.dist is not None:
+                results = self.dist.execute(index, pql, shards=shards)
+            else:
+                results = self.executor.execute(index, pql, shards=shards)
         except (ExecuteError, ParseError, ValueError, TypeError) as e:
             raise ApiError(str(e))
         return {"results": result_to_json(results)}
@@ -110,8 +170,15 @@ class API:
         self.holder.apply_schema(schema.get("indexes", []))
         self._sync()
 
-    def create_index(self, name: str, options: dict | None = None) -> dict:
+    def create_index(
+        self, name: str, options: dict | None = None, broadcast: bool = True
+    ) -> dict:
         self._validate("CreateIndex")
+        return self._create_index(name, options, broadcast)
+
+    def _create_index(
+        self, name: str, options: dict | None = None, broadcast: bool = True
+    ) -> dict:
         options = options or {}
         with self._lock:
             if self.holder.index(name) is not None:
@@ -125,14 +192,27 @@ class API:
             except ValueError as e:
                 raise ApiError(str(e))
         self._sync()
+        if broadcast:
+            from pilosa_tpu.cluster import broadcast as bc
+
+            self._broadcast(
+                {"type": bc.MSG_CREATE_INDEX, "index": name, "options": options}
+            )
         return idx.to_dict()
 
-    def delete_index(self, name: str) -> None:
+    def delete_index(self, name: str, broadcast: bool = True) -> None:
         self._validate("DeleteIndex")
+        self._delete_index(name, broadcast)
+
+    def _delete_index(self, name: str, broadcast: bool = True) -> None:
         if not self.holder.delete_index(name):
             raise NotFoundError("index not found")
         if self.store is not None:
             self.store.delete_index_dir(name)
+        if broadcast:
+            from pilosa_tpu.cluster import broadcast as bc
+
+            self._broadcast({"type": bc.MSG_DELETE_INDEX, "index": name})
 
     def index_info(self, name: str) -> dict:
         self._validate("Index")
@@ -141,8 +221,23 @@ class API:
             raise NotFoundError("index not found")
         return idx.to_dict()
 
-    def create_field(self, index: str, field: str, options: dict | None = None) -> dict:
+    def create_field(
+        self,
+        index: str,
+        field: str,
+        options: dict | None = None,
+        broadcast: bool = True,
+    ) -> dict:
         self._validate("CreateField")
+        return self._create_field(index, field, options, broadcast)
+
+    def _create_field(
+        self,
+        index: str,
+        field: str,
+        options: dict | None = None,
+        broadcast: bool = True,
+    ) -> dict:
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError("index not found")
@@ -153,10 +248,24 @@ class API:
         except ValueError as e:
             raise ApiError(str(e))
         self._sync()
+        if broadcast:
+            from pilosa_tpu.cluster import broadcast as bc
+
+            self._broadcast(
+                {
+                    "type": bc.MSG_CREATE_FIELD,
+                    "index": index,
+                    "field": field,
+                    "options": options or {},
+                }
+            )
         return f.to_dict()
 
-    def delete_field(self, index: str, field: str) -> None:
+    def delete_field(self, index: str, field: str, broadcast: bool = True) -> None:
         self._validate("DeleteField")
+        self._delete_field(index, field, broadcast)
+
+    def _delete_field(self, index: str, field: str, broadcast: bool = True) -> None:
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError("index not found")
@@ -164,6 +273,12 @@ class API:
             raise NotFoundError("field not found")
         if self.store is not None:
             self.store.delete_field_dir(index, field)
+        if broadcast:
+            from pilosa_tpu.cluster import broadcast as bc
+
+            self._broadcast(
+                {"type": bc.MSG_DELETE_FIELD, "index": index, "field": field}
+            )
 
     def field_info(self, index: str, field: str) -> dict:
         self._validate("Field")
@@ -177,7 +292,13 @@ class API:
 
     def import_bits(self, index: str, field: str, req: dict) -> None:
         """JSON bulk import: rowIDs/rowKeys + columnIDs/columnKeys
-        (+ timestamps), or columnIDs/columnKeys + values for int fields."""
+        (+ timestamps), or columnIDs/columnKeys + values for int fields.
+
+        In cluster mode the receiving node acts as import coordinator
+        (reference api.go:919-1112): it translates keys once, splits the
+        batch by shard, applies the locally-owned slice, and forwards each
+        remaining slice to every replica owning its shard (api.go:964-995),
+        marked ``remote`` so receivers do not re-forward."""
         self._validate("Import")
         idx = self.holder.index(index)
         if idx is None:
@@ -196,6 +317,9 @@ class API:
                 raise ApiError("columnKeys given but index does not use keys")
             cols = translator.translate_keys(index, "", keys)
         cols = np.asarray(cols, dtype=np.uint64)
+
+        if not req.get("remote") and self._route_import(index, f, req, cols):
+            return
 
         if "values" in req:
             if not f.is_bsi():
@@ -234,13 +358,118 @@ class API:
         if ef is not None and not req.get("clear", False):
             ef.import_bits(np.zeros(len(cols), dtype=np.uint64), cols)
 
-    def import_roaring(self, index: str, field: str, shard: int, data: bytes, clear: bool = False, view: str = VIEW_STANDARD) -> dict:
+    def _route_import(self, index: str, f, req: dict, cols: np.ndarray) -> bool:
+        """Cluster import routing (reference api.go:964-995). Returns True
+        when the batch was split and dispatched shard-wise to owning
+        nodes; False when the caller should apply it wholly locally."""
+        if (
+            self.cluster is None
+            or self.client is None
+            or len(self.cluster.nodes) <= 1
+        ):
+            return False
+        translator = self.executor.translator
+        values = req.get("values")
+        rows = None
+        if values is None:
+            rows = req.get("rowIDs")
+            if rows is None:
+                keys = req.get("rowKeys")
+                if keys is None:
+                    raise ApiError("rowIDs or rowKeys required")
+                if not f.keys:
+                    raise ApiError("rowKeys given but field does not use keys")
+                rows = translator.translate_keys(index, f.name, keys)
+            rows = np.asarray(rows, dtype=np.uint64)
+            if len(rows) != len(cols):
+                raise ApiError("rows/columns length mismatch")
+        else:
+            values = np.asarray(values, dtype=np.int64)
+            if len(values) != len(cols):
+                raise ApiError("columns/values length mismatch")
+        timestamps = req.get("timestamps")
+        width = f.n_words * 32
+        shards = cols // np.uint64(width)
+        node_masks: dict[str, np.ndarray] = {}
+        node_uri: dict[str, str] = {}
+        for s in np.unique(shards):
+            m = shards == s
+            for node in self.cluster.shard_nodes(index, int(s)):
+                node_uri[node.id] = node.uri
+                node_masks[node.id] = (
+                    m if node.id not in node_masks else (node_masks[node.id] | m)
+                )
+        # Dispatch every node's slice before reporting errors, so one dead
+        # replica can't leave later nodes' slices silently undelivered.
+        errors: list[str] = []
+        for node_id, mask in node_masks.items():
+            sub: dict = {
+                "columnIDs": [int(c) for c in cols[mask]],
+                "remote": True,
+            }
+            if values is not None:
+                sub["values"] = [int(v) for v in values[mask]]
+            else:
+                sub["rowIDs"] = [int(r) for r in rows[mask]]
+            if timestamps is not None:
+                idxs = np.nonzero(mask)[0]
+                sub["timestamps"] = [timestamps[i] for i in idxs]
+            if req.get("clear"):
+                sub["clear"] = True
+            try:
+                if node_id == self.cluster.node_id:
+                    self.import_bits(index, f.name, sub)
+                else:
+                    self.client.import_bits(node_uri[node_id], index, f.name, sub)
+            except Exception as e:
+                errors.append(f"{node_id}: {e}")
+        if errors:
+            raise ApiError(
+                "import partially failed on node(s): " + "; ".join(errors), 500
+            )
+        return True
+
+    def import_roaring(self, index: str, field: str, shard: int, data: bytes, clear: bool = False, view: str = VIEW_STANDARD, remote: bool = False) -> dict:
         """Binary roaring import: the highest-throughput ingest path
-        (reference api.go:367-427; call stack SURVEY §3.4)."""
+        (reference api.go:367-427; call stack SURVEY §3.4). In cluster
+        mode the batch is applied on every replica owning the shard
+        (api.go:400-404)."""
         self._validate("ImportRoaring")
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError("field not found")
+        if (
+            not remote
+            and self.cluster is not None
+            and self.client is not None
+            and len(self.cluster.nodes) > 1
+        ):
+            changed = 0
+            errors: list[str] = []
+            for node in self.cluster.shard_nodes(index, shard):
+                try:
+                    if node.id == self.cluster.node_id:
+                        changed = self.import_roaring(
+                            index, field, shard, data, clear=clear, view=view,
+                            remote=True,
+                        )["changed"]
+                    else:
+                        resp = self.client.import_roaring(
+                            node.uri, index, field, shard, data, clear=clear,
+                            view=view,
+                        )
+                        # All replicas apply the same batch; any replica's
+                        # changed count is THE changed count.
+                        if isinstance(resp, dict) and "changed" in resp:
+                            changed = resp["changed"]
+                except Exception as e:
+                    errors.append(f"{node.id}: {e}")
+            if errors:
+                raise ApiError(
+                    "import-roaring failed on replica(s): " + "; ".join(errors),
+                    500,
+                )
+            return {"changed": changed}
         try:
             positions = roaring.deserialize(data)
         except roaring.RoaringError as e:
@@ -327,9 +556,97 @@ class API:
             }
         }
 
+    # -- fragment internals (reference api.go:590-660 fragment block
+    #    endpoints; used by anti-entropy sync and resize) -------------------
+
+    def _fragment(self, index: str, field: str, view: str, shard: int):
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise NotFoundError(
+                f"fragment not found: {index}/{field}/{view}/{shard}"
+            )
+        return frag
+
+    def fragment_blocks(self, index: str, field: str, view: str, shard: int) -> dict:
+        self._validate("FragmentBlocks")
+        return {"blocks": self._fragment(index, field, view, shard).blocks()}
+
+    def fragment_block_data(self, req: dict) -> dict:
+        self._validate("FragmentBlockData")
+        frag = self._fragment(
+            req["index"], req["field"], req.get("view", VIEW_STANDARD),
+            int(req["shard"]),
+        )
+        rows, cols = frag.block_data(int(req["block"]))
+        return {"rows": rows, "cols": cols}
+
+    def fragment_data(self, index: str, field: str, view: str, shard: int) -> bytes:
+        """Whole-fragment snapshot as a roaring blob (reference
+        api.go FragmentData; fragment.go:2424-2594 tar WriteTo)."""
+        self._validate("FragmentData")
+        frag = self._fragment(index, field, view, shard)
+        return roaring.serialize(frag.all_positions())
+
+    def receive_message(self, msg: dict) -> dict:
+        """Handle a typed control-plane message from a peer (reference
+        Server.receiveMessage switch, server.go:549-643)."""
+        self._validate("ClusterMessage")
+        from pilosa_tpu.cluster import broadcast as bc
+
+        # Handlers call the _-prefixed internals: a cluster message must
+        # apply even when this node's own state gates the public method
+        # (e.g. a peer in STARTING receiving schema from the coordinator).
+        t = msg.get("type")
+        if t == bc.MSG_CREATE_INDEX:
+            try:
+                self._create_index(msg["index"], msg.get("options"), broadcast=False)
+            except ConflictError:
+                pass
+        elif t == bc.MSG_DELETE_INDEX:
+            try:
+                self._delete_index(msg["index"], broadcast=False)
+            except NotFoundError:
+                pass
+        elif t == bc.MSG_CREATE_FIELD:
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    self._create_field(
+                        msg["index"], msg["field"], msg.get("options"),
+                        broadcast=False,
+                    )
+                except ConflictError:
+                    pass
+        elif t == bc.MSG_DELETE_FIELD:
+            try:
+                self._delete_field(msg["index"], msg["field"], broadcast=False)
+            except NotFoundError:
+                pass
+        elif t == bc.MSG_CREATE_VIEW:
+            f = self.holder.field(msg["index"], msg["field"])
+            if f is not None:
+                f.create_view_if_not_exists(msg["view"])
+        elif t == bc.MSG_CREATE_SHARD:
+            f = self.holder.field(msg["index"], msg["field"])
+            if f is not None:
+                f.add_remote_available_shards([int(msg["shard"])])
+        elif t == bc.MSG_CLUSTER_STATUS:
+            if self.cluster is not None and hasattr(self.cluster, "set_state"):
+                self.cluster.set_state(msg["state"])
+        elif t == bc.MSG_NODE_STATE:
+            if self.cluster is not None and hasattr(self.cluster, "mark_node_state"):
+                self.cluster.mark_node_state(msg["node"], msg["state"])
+        elif t == bc.MSG_RECALCULATE_CACHES:
+            pass  # device row counts are exact; no cache to rebuild
+        return {}
+
     def translate_keys(self, index: str, field: str | None, keys: list[str]) -> list[int]:
         self._validate("TranslateKeys")
         return self.executor.translator.translate_keys(index, field or "", keys)
+
+    def translate_ids(self, index: str, field: str | None, ids: list[int]) -> list[str]:
+        self._validate("TranslateKeys")
+        return self.executor.translator.translate_ids(index, field or "", ids)
 
     def _node_id(self) -> str:
         if self.store is not None:

@@ -56,6 +56,12 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)$"), "delete_index"),
     ("GET", re.compile(r"^/internal/shards/max$"), "shards_max"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
+    ("POST", re.compile(r"^/internal/translate/ids$"), "translate_ids"),
+    ("POST", re.compile(r"^/internal/cluster/message$"), "cluster_message"),
+    ("GET", re.compile(r"^/internal/fragment/blocks$"), "fragment_blocks"),
+    ("POST", re.compile(r"^/internal/fragment/block/data$"), "fragment_block_data"),
+    ("GET", re.compile(r"^/internal/fragment/data$"), "fragment_data"),
+    ("GET", re.compile(r"^/internal/nodes$"), "nodes"),
 ]
 
 
@@ -150,8 +156,23 @@ class Handler(BaseHTTPRequestHandler):
         self._send_json(200, {})
 
     def r_query(self, index: str):
-        pql = self._body().decode()
+        """Accepts either a raw PQL body or a JSON envelope
+        ``{"query": ..., "shards": [...], "remote": bool}`` — the latter
+        is the node↔node fan-out form (reference QueryRequest,
+        internal/public.proto)."""
+        body = self._body()
+        remote = False
         shards = None
+        pql = body.decode()
+        if self.headers.get("Content-Type", "").startswith("application/json"):
+            try:
+                obj = json.loads(pql or "{}")
+            except json.JSONDecodeError:
+                obj = None  # raw PQL sent with a JSON content type
+            if isinstance(obj, dict):
+                pql = obj.get("query", "")
+                shards = obj.get("shards")
+                remote = bool(obj.get("remote"))
         if "shards" in self.query_params:
             shards = [
                 int(s)
@@ -159,7 +180,7 @@ class Handler(BaseHTTPRequestHandler):
                 for s in part.split(",")
                 if s
             ]
-        self._send_json(200, self.api.query(index, pql, shards=shards))
+        self._send_json(200, self.api.query(index, pql, shards=shards, remote=remote))
 
     def r_create_index(self, index: str):
         body = self._json_body()
@@ -189,10 +210,38 @@ class Handler(BaseHTTPRequestHandler):
 
     def r_import_roaring(self, index: str, field: str, shard: str):
         clear = self.query_params.get("clear", ["false"])[0] == "true"
+        remote = self.query_params.get("remote", ["false"])[0] == "true"
+        view = self.query_params.get("view", ["standard"])[0]
         result = self.api.import_roaring(
-            index, field, int(shard), self._body(), clear=clear
+            index, field, int(shard), self._body(), clear=clear, view=view,
+            remote=remote,
         )
         self._send_json(200, result)
+
+    def r_cluster_message(self):
+        self._send_json(200, self.api.receive_message(self._json_body()))
+
+    def r_nodes(self):
+        self._send_json(200, self.api.hosts())
+
+    def r_fragment_blocks(self):
+        p = {k: v[0] for k, v in self.query_params.items()}
+        self._send_json(
+            200,
+            self.api.fragment_blocks(
+                p["index"], p["field"], p.get("view", "standard"), int(p["shard"])
+            ),
+        )
+
+    def r_fragment_block_data(self):
+        self._send_json(200, self.api.fragment_block_data(self._json_body()))
+
+    def r_fragment_data(self):
+        p = {k: v[0] for k, v in self.query_params.items()}
+        data = self.api.fragment_data(
+            p["index"], p["field"], p.get("view", "standard"), int(p["shard"])
+        )
+        self._send(200, data, content_type="application/octet-stream")
 
     def r_export(self):
         index = self.query_params.get("index", [None])[0]
@@ -212,6 +261,13 @@ class Handler(BaseHTTPRequestHandler):
             body.get("index", ""), body.get("field", ""), body.get("keys", [])
         )
         self._send_json(200, {"ids": ids})
+
+    def r_translate_ids(self):
+        body = self._json_body()
+        keys = self.api.translate_ids(
+            body.get("index", ""), body.get("field", ""), body.get("ids", [])
+        )
+        self._send_json(200, {"keys": keys})
 
 
 class Server:

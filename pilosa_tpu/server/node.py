@@ -1,0 +1,151 @@
+"""Node composition root (reference: server.go Server/NewServer —
+wires holder, cluster, executor, transport into one cluster member).
+
+A ``NodeServer`` is one host process of a cluster: it owns a Holder
+(backed by a data dir when given), a Cluster view of the membership, an
+InternalClient for node↔node traffic, an HTTPBroadcaster for the control
+plane, and the HTTP listener. A standalone node (no ``join_static``)
+behaves exactly like the single-node server (the reference's
+cluster-disabled mode, server.go OptServerClusterDisabled).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.broadcast import HTTPBroadcaster
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.cluster import Cluster
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import Server
+from pilosa_tpu.shardwidth import SHARD_WORDS
+from pilosa_tpu.storage.disk import HolderStore
+
+
+class NodeServer:
+    def __init__(
+        self,
+        data_dir: str | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        replica_n: int = 1,
+        n_words: int = SHARD_WORDS,
+        long_query_time: float = 0.0,
+    ):
+        self.host = host
+        self.holder = Holder(n_words)
+        self.store = None
+        if data_dir is not None:
+            self.store = HolderStore(self.holder, data_dir)
+            self.store.open()
+        node_id = self.store.node_id() if self.store else uuid.uuid4().hex
+        self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
+        self.client = InternalClient()
+        self.broadcaster = HTTPBroadcaster(self.cluster, self.client, node_id)
+        self.api = API(
+            self.holder,
+            self.store,
+            cluster=self.cluster,
+            client=self.client,
+            broadcaster=self.broadcaster,
+        )
+        self._wire_shard_broadcasts()
+        # Route new-key allocation to the translation primary (reference
+        # translate.go:91-97); collapses to the local store standalone.
+        from pilosa_tpu.cluster.translate_proxy import PrimaryTranslateStore
+
+        proxy = PrimaryTranslateStore(
+            self.api.executor.translator, self.cluster, self.client
+        )
+        self.api.executor.translator = proxy
+        if self.api.dist is not None:
+            self.api.dist.local.translator = proxy
+        self.server = Server(
+            self.api, host=host, port=port, long_query_time=long_query_time
+        )
+
+    # -- shard availability broadcasts (reference view.go:239-261
+    #    CreateShardMessage) ------------------------------------------------
+
+    def _wire_shard_broadcasts(self) -> None:
+        """Chain a create-shard broadcast after any existing (storage)
+        fragment-creation hook so peers learn shard availability."""
+
+        def wire_field(idx, field):
+            prev = field.on_create_fragment
+
+            def on_fragment(view, shard, _prev=prev, _index=idx.name, _field=field.name):
+                if _prev is not None:
+                    _prev(view, shard)
+                self._broadcast_shard(_index, _field, shard)
+
+            field.on_create_fragment = on_fragment
+            for view in field.views.values():
+                view.on_create_fragment = on_fragment
+
+        def wire_index(idx):
+            prev = idx.on_create_field
+
+            def on_field(idx2, field, _prev=prev):
+                if _prev is not None:
+                    _prev(idx2, field)
+                wire_field(idx2, field)
+
+            idx.on_create_field = on_field
+            for f in list(idx.fields.values()):
+                wire_field(idx, f)
+
+        prev_idx = self.holder.on_create_index
+
+        def on_index(idx, _prev=prev_idx):
+            if _prev is not None:
+                _prev(idx)
+            wire_index(idx)
+
+        self.holder.on_create_index = on_index
+        for idx in list(self.holder.indexes.values()):
+            wire_index(idx)
+
+    def _broadcast_shard(self, index: str, field: str, shard: int) -> None:
+        if len(self.cluster.nodes) <= 1:
+            return
+        try:
+            self.broadcaster.send_sync(
+                {
+                    "type": bc.MSG_CREATE_SHARD,
+                    "index": index,
+                    "field": field,
+                    "shard": shard,
+                }
+            )
+        except Exception:
+            # Shard availability re-converges via node status exchange;
+            # a failed advisory broadcast must not fail the write path.
+            pass
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.serve_background()
+        self.cluster.local_node.uri = self.uri
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.server.port}"
+
+    @property
+    def node_id(self) -> str:
+        return self.cluster.node_id
+
+    def join_static(self, members: list[tuple[str, str]], coordinator_id: str) -> None:
+        """Fix cluster membership (reference cluster.go:2000 setStatic).
+        ``members`` is [(node_id, uri), ...] including this node."""
+        self.cluster.coordinator_id = coordinator_id
+        self.cluster.disabled = False
+        self.cluster.set_static([Node(id=i, uri=u) for i, u in members])
+
+    def stop(self) -> None:
+        self.server.close()

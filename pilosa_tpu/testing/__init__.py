@@ -1,0 +1,7 @@
+"""In-process test harness (reference: test/ package, 1000 LoC —
+test.MustRunCluster boots n real nodes with real transport on port 0,
+test/pilosa.go:344-400)."""
+
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+__all__ = ["InProcessCluster"]

@@ -1,0 +1,107 @@
+"""Multi-node in-process cluster for tests (reference: test/pilosa.go
+MustRunCluster :344-400, test/cluster.go).
+
+Boots n real ``NodeServer`` processes-in-threads with real HTTP
+listeners on auto-bound ports, fixes static membership (node 0 is the
+coordinator), and exposes the same conveniences as the reference's
+``test.Cluster``: schema creation through any node, shard-routed bit
+imports, and queries against every node.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+from pilosa_tpu.server.node import NodeServer
+from pilosa_tpu.shardwidth import SHARD_WORDS
+
+
+class InProcessCluster:
+    def __init__(
+        self,
+        n: int,
+        replica_n: int = 1,
+        n_words: int = SHARD_WORDS,
+        with_disk: bool = False,
+        long_query_time: float = 0.0,
+    ):
+        self._tmp = tempfile.TemporaryDirectory() if with_disk else None
+        self.nodes: list[NodeServer] = []
+        for i in range(n):
+            data_dir = f"{self._tmp.name}/node{i}" if self._tmp else None
+            node = NodeServer(
+                data_dir=data_dir,
+                replica_n=replica_n,
+                n_words=n_words,
+                long_query_time=long_query_time,
+            )
+            node.start()
+            self.nodes.append(node)
+        members = [(s.node_id, s.uri) for s in self.nodes]
+        members.sort()
+        self.coordinator_id = self.nodes[0].node_id
+        for s in self.nodes:
+            s.join_static(members, self.coordinator_id)
+
+    def __enter__(self) -> "InProcessCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def __getitem__(self, i: int) -> NodeServer:
+        return self.nodes[i]
+
+    @property
+    def coordinator(self) -> NodeServer:
+        for s in self.nodes:
+            if s.node_id == self.coordinator_id:
+                return s
+        raise RuntimeError("coordinator not in cluster")
+
+    # -- conveniences (reference test/cluster.go) ---------------------------
+
+    def create_index(self, name: str, options: dict | None = None) -> None:
+        self.nodes[0].api.create_index(name, options or {})
+
+    def create_field(self, index: str, field: str, options: dict | None = None) -> None:
+        self.nodes[0].api.create_field(index, field, options or {})
+
+    def query(self, node: int, index: str, pql: str) -> dict:
+        return self.nodes[node].api.query(index, pql)
+
+    def import_bits(self, index: str, field: str, bits: list[tuple[int, int]]) -> None:
+        """Route (row, col) pairs through node 0's import coordinator
+        (reference test/pilosa.go ImportBits :256-294 routes to owners)."""
+        self.nodes[0].api.import_bits(
+            index,
+            field,
+            {
+                "rowIDs": [r for r, _ in bits],
+                "columnIDs": [c for _, c in bits],
+            },
+        )
+
+    def owner_of(self, index: str, shard: int) -> NodeServer:
+        node_id = self.nodes[0].cluster.primary_shard_node(index, shard).id
+        for s in self.nodes:
+            if s.node_id == node_id:
+                return s
+        raise RuntimeError("owner not found")
+
+    def stop_node(self, i: int) -> None:
+        """Hard-stop one node (fault injection — the reference uses pumba
+        pause in internal/clustertests)."""
+        self.nodes[i].stop()
+
+    def close(self) -> None:
+        for s in self.nodes:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        if self._tmp is not None:
+            self._tmp.cleanup()
