@@ -1,0 +1,234 @@
+"""Anti-entropy replica repair (reference: holder.go:683-839 holderSyncer,
+fragment.go:2849-3011 fragmentSyncer, server.go:494-546 monitorAntiEntropy).
+
+Periodically, each node walks the fragments it owns and reconciles them
+with the other replicas:
+
+1. schema sync — pull every peer's schema and apply the union locally
+   (the reference exchanges full NodeStatus incl. schema on gossip
+   push/pull, gossip/gossip.go:321-357), healing missed broadcasts;
+2. per-fragment block sync — fetch 100-row block checksums from each
+   replica (fragment.go Blocks), and for every differing block fetch the
+   raw (row, col) pairs and merge to consensus: a bit survives when set
+   on >= ceil(n/2) replicas, ties keep the bit (fragment.go:1914
+   majorityN); each replica then receives exactly its set/clear diff.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+from pilosa_tpu.cluster.client import ClientError
+
+logger = logging.getLogger("pilosa_tpu.antientropy")
+
+
+class HolderSyncer:
+    """reference holder.go:683 holderSyncer."""
+
+    def __init__(self, holder, cluster, client, api):
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client
+        self.api = api
+
+    # -- entry point --------------------------------------------------------
+
+    def sync_holder(self) -> dict:
+        """One full anti-entropy pass. Returns counters for observability
+        (reference SyncHolder holder.go:683)."""
+        stats = {"fragments": 0, "blocks_diff": 0, "bits_set": 0, "bits_cleared": 0}
+        if len(self.cluster.nodes) <= 1:
+            return stats
+        self.sync_schema()
+        for index_name in list(self.holder.index_names()):
+            idx = self.holder.index(index_name)
+            if idx is None:
+                continue
+            for fname in idx.field_names(include_internal=True):
+                field = idx.field(fname)
+                if field is None:
+                    continue
+                for vname in field.view_names():
+                    view = field.view(vname)
+                    for shard in sorted(view.fragments):
+                        if not self.cluster.owns_shard(
+                            self.cluster.node_id, index_name, shard
+                        ):
+                            continue
+                        self.sync_fragment(
+                            index_name, fname, vname, shard, stats
+                        )
+                        stats["fragments"] += 1
+        return stats
+
+    def sync_schema(self) -> None:
+        """Apply the union of all peers' schemas locally (missed
+        create-index/create-field broadcasts heal here)."""
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node_id:
+                continue
+            try:
+                status = self.client.status(node.uri)
+            except ClientError:
+                continue
+            schema = status.get("schema")
+            if schema:
+                try:
+                    self.holder.apply_schema(schema)
+                except Exception as e:
+                    logger.warning("schema sync from %s failed: %s", node.id, e)
+            # shard-availability exchange (reference NodeStatus carries
+            # available-shard bitmaps, gossip.go:321-357)
+            if status.get("availableShards"):
+                self.api.merge_available_shards(status["availableShards"])
+
+    # -- fragment sync (reference fragment.go:2849 syncFragment) ------------
+
+    def sync_fragment(
+        self, index: str, field: str, view: str, shard: int, stats: dict
+    ) -> None:
+        replicas = [
+            n
+            for n in self.cluster.shard_nodes(index, shard)
+            if n.id != self.cluster.node_id
+        ]
+        if not replicas:
+            return
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            return
+        local_blocks = {b["id"]: b["checksum"] for b in frag.blocks()}
+        # Union of block ids that differ from ANY replica.
+        remote_blocks: dict[str, dict[int, str]] = {}
+        diff_ids: set[int] = set()
+        for node in replicas:
+            try:
+                blocks = self.client.fragment_blocks(
+                    node.uri, index, field, view, shard
+                )
+                rb = {b["id"]: b["checksum"] for b in blocks}
+            except ClientError as e:
+                if e.code == 404:
+                    rb = {}  # replica has no fragment yet: all blocks differ
+                else:
+                    logger.warning("blocks fetch from %s failed: %s", node.id, e)
+                    continue
+            remote_blocks[node.id] = rb
+            for bid in set(local_blocks) | set(rb):
+                if local_blocks.get(bid) != rb.get(bid):
+                    diff_ids.add(bid)
+        for bid in sorted(diff_ids):
+            stats["blocks_diff"] += 1
+            self._merge_block(
+                index, field, view, shard, bid, frag, replicas,
+                remote_blocks, stats,
+            )
+
+    def _merge_block(
+        self, index, field, view, shard, block, frag, replicas,
+        remote_blocks, stats,
+    ) -> None:
+        """Majority-consensus merge of one block (reference
+        fragment.go:1873-1991 mergeBlock + syncBlock :2900-3011)."""
+        pair_sets: dict[str, set[tuple[int, int]]] = {}
+        lrows, lcols = frag.block_data(block)
+        pair_sets[self.cluster.node_id] = set(zip(lrows, lcols))
+        for node in replicas:
+            if node.id not in remote_blocks:
+                continue  # unreachable earlier; skip from consensus
+            try:
+                data = self.client.block_data(
+                    node.uri, index, field, view, shard, block
+                )
+                pair_sets[node.id] = set(zip(data["rows"], data["cols"]))
+            except ClientError as e:
+                if e.code == 404:
+                    pair_sets[node.id] = set()
+                else:
+                    logger.warning("block data from %s failed: %s", node.id, e)
+        n = len(pair_sets)
+        if n <= 1:
+            return
+        majority = (n + 1) // 2  # ties keep the bit (fragment.go:1914)
+        counts: dict[tuple[int, int], int] = {}
+        for pairs in pair_sets.values():
+            for p in pairs:
+                counts[p] = counts.get(p, 0) + 1
+        keep = {p for p, c in counts.items() if c >= majority}
+        # Per-replica diffs: sets = keep - have, clears = have - keep.
+        for node_id, have in pair_sets.items():
+            to_set = keep - have
+            to_clear = have - keep
+            if not to_set and not to_clear:
+                continue
+            stats["bits_set"] += len(to_set)
+            stats["bits_cleared"] += len(to_clear)
+            if node_id == self.cluster.node_id:
+                self._apply_local(frag, to_set, to_clear)
+            else:
+                node = self.cluster.node(node_id)
+                self._push_remote(
+                    node, index, field, view, shard, frag, to_set, to_clear
+                )
+
+    def _apply_local(self, frag, to_set, to_clear) -> None:
+        if to_set:
+            rows = np.array([r for r, _ in to_set], dtype=np.uint64)
+            cols = np.array([c for _, c in to_set], dtype=np.int64)
+            frag.import_bits(rows, cols)
+        if to_clear:
+            rows = np.array([r for r, _ in to_clear], dtype=np.uint64)
+            cols = np.array([c for _, c in to_clear], dtype=np.int64)
+            frag.import_bits(rows, cols, clear=True)
+
+    def _push_remote(
+        self, node, index, field, view, shard, frag, to_set, to_clear
+    ) -> None:
+        """Ship diffs as roaring batches (the reference pushes syncs
+        through ImportRoaring too, fragment.go:2975-3011)."""
+        from pilosa_tpu.storage import roaring
+
+        width = frag.shard_width
+        try:
+            for pairs, clear in ((to_set, False), (to_clear, True)):
+                if not pairs:
+                    continue
+                positions = np.sort(
+                    np.array(
+                        [r * width + c for r, c in pairs], dtype=np.uint64
+                    )
+                )
+                self.client.import_roaring(
+                    node.uri, index, field, shard,
+                    roaring.serialize(positions), clear=clear, view=view,
+                )
+        except ClientError as e:
+            logger.warning("sync push to %s failed: %s", node.id, e)
+
+
+class AntiEntropyLoop:
+    """Background interval loop (reference server.go:494-546)."""
+
+    def __init__(self, syncer: HolderSyncer, interval: float):
+        self.syncer = syncer
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.syncer.sync_holder()
+            except Exception as e:
+                logger.warning("anti-entropy pass failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()

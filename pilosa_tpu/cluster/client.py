@@ -128,6 +128,16 @@ class InternalClient:
             f"&view={view}&shard={shard}",
         )
 
+    def fragment_list(self, uri: str) -> list[dict]:
+        """Node's full fragment inventory for resize planning (reference
+        fragsByHost cluster.go:687)."""
+        return self._json("GET", uri, "/internal/fragments")["fragments"]
+
+    def resize_fetch(self, uri: str, req: dict) -> None:
+        """Tell a node to fetch the listed fragments from their sources
+        (reference followResizeInstruction cluster.go:1272)."""
+        self._json("POST", uri, "/internal/resize/fetch", req)
+
     # -- control plane ------------------------------------------------------
 
     def send_message(self, uri: str, msg: dict) -> None:
@@ -182,6 +192,12 @@ class NopInternalClient:
 
     def retrieve_fragment(self, uri, index, field, view, shard):
         return b""
+
+    def fragment_list(self, uri):
+        return []
+
+    def resize_fetch(self, uri, req):
+        pass
 
     def send_message(self, uri, msg):
         pass

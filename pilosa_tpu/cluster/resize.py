@@ -1,0 +1,224 @@
+"""Elastic cluster resize (reference: cluster.go:687-844 fragSources /
+fragsDiff, :1038-1536 resizeJob / followResizeInstruction).
+
+TPU meshes are static, so within one process resize never happens — this
+implements the reference's *cluster-level* elasticity: adding or removing
+a host re-runs jump-hash placement over the new membership and moves only
+the fragments whose owner set changed (jump consistent hashing guarantees
+that set is minimal).
+
+Flow, coordinator-driven exactly like the reference (one membership
+change at a time, cluster.go:1038):
+
+1. coordinator broadcasts RESIZING (API gates to fragment-transfer-only,
+   api.go:100-124);
+2. it gathers the global fragment inventory from every old member,
+   computes, per NEW member, the fragments that member will own under the
+   new placement but does not hold, each with a source node that does
+   (reference fragSources);
+3. each member synchronously fetches its missing fragments from the
+   sources (reference followResizeInstruction streams fragment archives);
+4. coordinator commits the new membership + NORMAL state to every member
+   (reference mergeClusterStatus), and each drops fragments it no longer
+   owns (reference holderCleaner, holder.go:898-926).
+
+On failure the coordinator broadcasts an abort: old membership + NORMAL
+(reference ResizeAbort api.go:1249).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.cluster.cluster import (
+    Cluster,
+    STATE_NORMAL,
+    STATE_RESIZING,
+)
+from pilosa_tpu.cluster.topology import Node
+
+logger = logging.getLogger("pilosa_tpu.resize")
+
+
+class ResizeError(Exception):
+    pass
+
+
+class ResizeCoordinator:
+    """Runs on the coordinator node (reference: only the coordinator
+    generates resize jobs, cluster.go:1171)."""
+
+    def __init__(self, cluster: Cluster, client, api):
+        self.cluster = cluster
+        self.client = client
+        self.api = api
+
+    # -- public entry points ------------------------------------------------
+
+    def add_node(self, node_id: str, uri: str) -> None:
+        if self.cluster.node(node_id) is not None:
+            return
+        new_nodes = [
+            Node(id=n.id, uri=n.uri) for n in self.cluster.nodes
+        ] + [Node(id=node_id, uri=uri)]
+        self._resize(sorted(new_nodes))
+
+    def remove_node(self, node_id: str) -> None:
+        if self.cluster.node(node_id) is None:
+            raise ResizeError(f"node not in cluster: {node_id}")
+        if node_id == self.cluster.node_id:
+            raise ResizeError("coordinator cannot remove itself")
+        new_nodes = [
+            Node(id=n.id, uri=n.uri)
+            for n in self.cluster.nodes
+            if n.id != node_id
+        ]
+        if not new_nodes:
+            raise ResizeError("cannot remove the last node")
+        self._resize(new_nodes, removed=node_id)
+
+    # -- the job ------------------------------------------------------------
+
+    def _resize(self, new_nodes: list[Node], removed: str | None = None) -> None:
+        old_nodes = list(self.cluster.nodes)
+        all_nodes = {n.id: n for n in old_nodes}
+        for n in new_nodes:
+            all_nodes.setdefault(n.id, n)
+
+        # 1. everyone (old + joining) enters RESIZING.
+        self._send_state_everywhere(all_nodes.values(), STATE_RESIZING)
+        try:
+            # 2. inventory: which old member holds which fragments.
+            holders = self._gather_inventory(old_nodes, exclude=removed)
+            # 3. placement under the new membership.
+            new_cluster = Cluster(
+                self.cluster.node_id,
+                replica_n=self.cluster.replica_n,
+                partition_n=self.cluster.partition_n,
+                coordinator_id=self.cluster.coordinator_id,
+            )
+            new_cluster.set_static([Node(id=n.id, uri=n.uri) for n in new_nodes])
+            # 4. per new member: fetch instructions for missing fragments.
+            old_ids = {n.id for n in old_nodes}
+            for target in new_nodes:
+                is_joining = target.id not in old_ids
+                instructions = []
+                for frag_key, holder_ids in holders.items():
+                    index, field, view, shard = frag_key
+                    if not new_cluster.owns_shard(target.id, index, shard):
+                        continue
+                    if target.id in holder_ids:
+                        continue
+                    # Prefer a staying holder; a gracefully-leaving node
+                    # still serves as source (the reference streams from
+                    # the leaving node on removal).
+                    source = next(
+                        (all_nodes[h] for h in holder_ids if h != removed),
+                        all_nodes[removed] if removed in holder_ids else None,
+                    )
+                    if source is None:
+                        raise ResizeError(
+                            f"no live source for fragment {frag_key}"
+                        )
+                    instructions.append(
+                        {
+                            "index": index,
+                            "field": field,
+                            "view": view,
+                            "shard": shard,
+                            "sourceURI": source.uri,
+                        }
+                    )
+                if instructions or is_joining:
+                    # Joining nodes get the schema first (reference
+                    # followResizeInstruction applies schema before any
+                    # fragment transfer, cluster.go:1304-1323).
+                    self._dispatch_fetch(target, instructions, is_joining)
+        except Exception:
+            # Abort: restore old membership + NORMAL on every reachable
+            # node (reference ResizeAbort).
+            self._commit_membership(all_nodes.values(), old_nodes)
+            raise
+        # 5. commit: new membership + NORMAL everywhere, then cleanup.
+        # The commit carries the global shard-availability map so every
+        # node re-learns which shards exist cluster-wide (local holdings
+        # changed; stale remote sets would shrink query fan-out).
+        shard_map: dict = {}
+        for (index, field, _view, shard) in holders:
+            shard_map.setdefault(index, {}).setdefault(field, set()).add(shard)
+        shard_map = {
+            i: {f: sorted(s) for f, s in fields.items()}
+            for i, fields in shard_map.items()
+        }
+        self._commit_membership(all_nodes.values(), new_nodes, shard_map)
+
+    def _send_state_everywhere(self, nodes, state: str) -> None:
+        for n in nodes:
+            if n.id == self.cluster.node_id:
+                self.cluster.set_state(state)
+            else:
+                try:
+                    self.client.send_message(
+                        n.uri, {"type": bc.MSG_CLUSTER_STATUS, "state": state}
+                    )
+                except ClientError as e:
+                    logger.warning("state fan-out to %s failed: %s", n.id, e)
+
+    def _gather_inventory(
+        self, old_nodes, exclude: str | None
+    ) -> dict[tuple, list[str]]:
+        """fragment key -> node ids actually holding it (reference
+        fragsByHost cluster.go:687)."""
+        holders: dict[tuple, list[str]] = {}
+        for n in old_nodes:
+            if n.id == self.cluster.node_id:
+                frags = self.api.fragment_inventory()
+            else:
+                try:
+                    frags = self.client.fragment_list(n.uri)
+                except ClientError as e:
+                    if exclude is not None and n.id == exclude:
+                        continue  # removing a dead node: its data is lost
+                    raise ResizeError(
+                        f"inventory fetch from {n.id} failed: {e}"
+                    )
+            for fr in frags:
+                key = (fr["index"], fr["field"], fr["view"], fr["shard"])
+                holders.setdefault(key, []).append(n.id)
+        return holders
+
+    def _dispatch_fetch(
+        self, target: Node, instructions: list[dict], with_schema: bool = False
+    ) -> None:
+        req: dict = {"instructions": instructions}
+        if with_schema:
+            req["schema"] = self.api.holder.schema()
+        if target.id == self.cluster.node_id:
+            self.api.resize_fetch(req)
+        else:
+            self.client.resize_fetch(target.uri, req)
+
+    def _commit_membership(
+        self, all_nodes, members: list[Node], shard_map: dict | None = None
+    ) -> None:
+        status = {
+            "type": bc.MSG_CLUSTER_STATUS,
+            "state": STATE_NORMAL,
+            "coordinator": self.cluster.coordinator_id,
+            "nodes": [{"id": n.id, "uri": n.uri} for n in members],
+        }
+        if shard_map:
+            status["availableShards"] = shard_map
+        member_ids = {n.id for n in members}
+        for n in all_nodes:
+            if n.id == self.cluster.node_id:
+                self.api.receive_message(status)
+            else:
+                try:
+                    self.client.send_message(n.uri, status)
+                except ClientError as e:
+                    # A removed node that is already gone is expected here.
+                    if n.id in member_ids:
+                        logger.warning("commit to %s failed: %s", n.id, e)

@@ -50,6 +50,14 @@ class View:
     def available_shards(self) -> set[int]:
         return set(self.fragments)
 
+    def drop_fragment(self, shard: int) -> bool:
+        """Remove a fragment from memory (reference holder.go:898-926
+        holderCleaner). Disk-backed callers must also detach the backing
+        store via HolderStore.delete_fragment — file lifecycle belongs to
+        the storage layer, not the data model."""
+        with self._lock:
+            return self.fragments.pop(shard, None) is not None
+
     # -- column-addressed ops (abs column -> shard + offset) ---------------
 
     def _split(self, col: int) -> tuple[int, int]:

@@ -470,6 +470,11 @@ class API:
                     500,
                 )
             return {"changed": changed}
+        return self._apply_roaring(index, f, shard, data, clear, view)
+
+    def _apply_roaring(self, index: str, f, shard: int, data: bytes, clear: bool, view: str) -> dict:
+        """Local roaring apply, state-gate-free (also the landing path for
+        resize fragment transfers, which run while gated to RESIZING)."""
         try:
             positions = roaring.deserialize(data)
         except roaring.RoaringError as e:
@@ -480,6 +485,14 @@ class API:
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(shard)
         changed = frag.import_bits(rows, cols_local, clear=clear)
+        if view.startswith("bsig_") and f.is_bsi() and len(rows):
+            # Restore bit depth from the transferred planes: schema carries
+            # only FieldOptions, and depth auto-grows per node (reference
+            # field.go:1050-1067) — without this a resize-transferred int
+            # fragment would read as all-zero on the new owner.
+            from pilosa_tpu.core.fragment import BSI_OFFSET_BIT
+
+            f.grow_bit_depth(int(rows.max()) - BSI_OFFSET_BIT + 1)
         idx = self.holder.index(index)
         ef = idx.existence_field() if idx is not None else None
         if ef is not None and not clear and len(cols_local):
@@ -532,7 +545,15 @@ class API:
             if self.cluster is not None
             else [{"id": self._node_id(), "uri": "", "isCoordinator": True, "state": "READY"}]
         )
-        return {"state": self.state, "nodes": nodes, "localID": self._node_id()}
+        # schema rides along for peer status exchange (the reference's
+        # NodeStatus carries schema on gossip push/pull, gossip.go:321-357).
+        return {
+            "state": self.state,
+            "nodes": nodes,
+            "localID": self._node_id(),
+            "schema": self.holder.schema(),
+            "availableShards": self.available_shards_map(),
+        }
 
     def info(self) -> dict:
         self._validate("Info")
@@ -587,6 +608,117 @@ class API:
         frag = self._fragment(index, field, view, shard)
         return roaring.serialize(frag.all_positions())
 
+    def available_shards_map(self) -> dict:
+        """{index: {field: [shards]}} of shards available cluster-wide as
+        this node knows them (reference field.go AvailableShards union:
+        local + remote)."""
+        out: dict = {}
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            fields = {}
+            for fname in idx.field_names(include_internal=True):
+                field = idx.field(fname)
+                if field is not None:
+                    fields[fname] = sorted(field.available_shards())
+            out[iname] = fields
+        return out
+
+    def merge_available_shards(self, shard_map: dict) -> None:
+        """Merge a peer's (or the resize coordinator's) shard-availability
+        map (reference field.go:331-345 AddRemoteAvailableShards)."""
+        for iname, fields in (shard_map or {}).items():
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for fname, shards in fields.items():
+                field = idx.field(fname)
+                if field is not None:
+                    field.add_remote_available_shards(shards)
+
+    def fragment_inventory(self) -> list[dict]:
+        """Every fragment this node holds, for resize planning (reference
+        fragsByHost cluster.go:687)."""
+        self._validate("FragmentData")
+        out = []
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for fname in idx.field_names(include_internal=True):
+                field = idx.field(fname)
+                if field is None:
+                    continue
+                for vname in field.view_names():
+                    for shard in sorted(field.view(vname).fragments):
+                        out.append(
+                            {
+                                "index": iname,
+                                "field": fname,
+                                "view": vname,
+                                "shard": shard,
+                            }
+                        )
+        return out
+
+    def resize_fetch(self, req: dict) -> dict:
+        """Fetch and install the listed fragments from their source nodes
+        (reference followResizeInstruction cluster.go:1272-1381). Runs
+        while the cluster is gated to RESIZING."""
+        self._validate("FragmentData")
+        if self.client is None:
+            raise ApiError("no internal client configured", 500)
+        if req.get("schema"):
+            # Joining node: install schema before fragment transfer
+            # (reference cluster.go:1304-1323).
+            self.holder.apply_schema(req["schema"])
+            self._sync()
+        fetched = 0
+        for ins in req.get("instructions", []):
+            index, fname = ins["index"], ins["field"]
+            f = self.holder.field(index, fname)
+            if f is None:
+                raise ApiError(
+                    f"resize target missing schema for {index}/{fname}", 500
+                )
+            data = self.client.retrieve_fragment(
+                ins["sourceURI"], index, fname, ins["view"], int(ins["shard"])
+            )
+            self._apply_roaring(
+                index, f, int(ins["shard"]), data, False, ins["view"]
+            )
+            fetched += 1
+        return {"fetched": fetched}
+
+    def _clean_unowned_fragments(self) -> int:
+        """Drop fragments this node no longer owns after a membership
+        change (reference holderCleaner holder.go:898-926)."""
+        if self.cluster is None or not hasattr(self.cluster, "owns_shard"):
+            return 0
+        dropped = 0
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for fname in idx.field_names(include_internal=True):
+                field = idx.field(fname)
+                if field is None:
+                    continue
+                for vname in field.view_names():
+                    view = field.view(vname)
+                    for shard in sorted(view.fragments):
+                        if not self.cluster.owns_shard(
+                            self.cluster.node_id, iname, shard
+                        ):
+                            view.drop_fragment(shard)
+                            if self.store is not None:
+                                self.store.delete_fragment(
+                                    iname, fname, vname, shard
+                                )
+                            dropped += 1
+        return dropped
+
     def receive_message(self, msg: dict) -> dict:
         """Handle a typed control-plane message from a peer (reference
         Server.receiveMessage switch, server.go:549-643)."""
@@ -632,7 +764,28 @@ class API:
                 f.add_remote_available_shards([int(msg["shard"])])
         elif t == bc.MSG_CLUSTER_STATUS:
             if self.cluster is not None and hasattr(self.cluster, "set_state"):
+                nodes = msg.get("nodes")
+                if nodes:
+                    # Membership commit from the resize coordinator
+                    # (reference mergeClusterStatus cluster.go:1918-1978).
+                    from pilosa_tpu.cluster.topology import Node as CNode
+
+                    if msg.get("coordinator"):
+                        self.cluster.coordinator_id = msg["coordinator"]
+                    self.cluster.disabled = False
+                    self.cluster.set_static(
+                        [CNode(id=n["id"], uri=n.get("uri", "")) for n in nodes]
+                    )
                 self.cluster.set_state(msg["state"])
+                if msg.get("availableShards"):
+                    self.merge_available_shards(msg["availableShards"])
+                still_member = not nodes or any(
+                    n["id"] == self.cluster.node_id for n in nodes
+                )
+                if nodes and msg["state"] == STATE_NORMAL and still_member:
+                    # A removed node keeps its data (the reference expects
+                    # it to shut down; its fragments were re-sourced).
+                    self._clean_unowned_fragments()
         elif t == bc.MSG_NODE_STATE:
             if self.cluster is not None and hasattr(self.cluster, "mark_node_state"):
                 self.cluster.mark_node_state(msg["node"], msg["state"])

@@ -61,6 +61,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "fragment_blocks"),
     ("POST", re.compile(r"^/internal/fragment/block/data$"), "fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "fragment_data"),
+    ("GET", re.compile(r"^/internal/fragments$"), "fragments"),
+    ("POST", re.compile(r"^/internal/resize/fetch$"), "resize_fetch"),
     ("GET", re.compile(r"^/internal/nodes$"), "nodes"),
 ]
 
@@ -217,6 +219,12 @@ class Handler(BaseHTTPRequestHandler):
             remote=remote,
         )
         self._send_json(200, result)
+
+    def r_fragments(self):
+        self._send_json(200, {"fragments": self.api.fragment_inventory()})
+
+    def r_resize_fetch(self):
+        self._send_json(200, self.api.resize_fetch(self._json_body()))
 
     def r_cluster_message(self):
         self._send_json(200, self.api.receive_message(self._json_body()))

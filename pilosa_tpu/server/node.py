@@ -140,6 +140,21 @@ class NodeServer:
     def node_id(self) -> str:
         return self.cluster.node_id
 
+    def resize_coordinator(self):
+        """Resize entry point; valid only on the coordinator (reference
+        cluster.go:1171 unprotectedGenerateResizeJob)."""
+        from pilosa_tpu.cluster.resize import ResizeCoordinator, ResizeError
+
+        if not self.cluster.is_coordinator:
+            raise ResizeError("resize must run on the coordinator")
+        return ResizeCoordinator(self.cluster, self.client, self.api)
+
+    def syncer(self):
+        """Anti-entropy syncer for this node (reference holderSyncer)."""
+        from pilosa_tpu.cluster.antientropy import HolderSyncer
+
+        return HolderSyncer(self.holder, self.cluster, self.client, self.api)
+
     def join_static(self, members: list[tuple[str, str]], coordinator_id: str) -> None:
         """Fix cluster membership (reference cluster.go:2000 setStatic).
         ``members`` is [(node_id, uri), ...] including this node."""

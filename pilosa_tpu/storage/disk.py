@@ -203,6 +203,19 @@ class HolderStore:
         if os.path.isdir(d):
             shutil.rmtree(d)
 
+    def delete_fragment(self, index: str, field: str, view: str, shard: int) -> None:
+        """Detach + delete one fragment's backing file (resize cleanup,
+        reference holderCleaner holder.go:898-926)."""
+        self._detach_stores(
+            lambda frag: frag.index == index
+            and frag.field == field
+            and frag.view == view
+            and frag.shard == shard
+        )
+        p = self._fragment_path(index, field, view, shard)
+        if os.path.exists(p):
+            os.remove(p)
+
     def delete_field_dir(self, index: str, name: str) -> None:
         import shutil
 

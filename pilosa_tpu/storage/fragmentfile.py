@@ -36,6 +36,7 @@ class FragmentFile:
         self.snapshot_queue = snapshot_queue
         self._lock = threading.Lock()
         self._fh = None
+        self._closed = False
         self.op_n = 0
         # per-mutation op batching (begin_batch/end_batch): buffered
         # positions flushed as single batch records. Caller guarantees the
@@ -170,6 +171,11 @@ class FragmentFile:
         writer path's fragment->store lock order) so a concurrent mutation
         can't interleave between the state gather and the file swap."""
         with self.fragment._lock, self._lock:
+            if self._closed:
+                # A snapshot queued before the store was detached (e.g.
+                # the fragment was dropped by resize cleanup) must not
+                # resurrect the deleted file.
+                return
             positions = self._all_positions()
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as f:
@@ -196,6 +202,7 @@ class FragmentFile:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None

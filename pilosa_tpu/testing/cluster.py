@@ -92,6 +92,41 @@ class InProcessCluster:
                 return s
         raise RuntimeError("owner not found")
 
+    def add_node(self) -> NodeServer:
+        """Boot a fresh node and resize it into the cluster through the
+        coordinator (reference server/cluster_test.go node-join tests)."""
+        data_dir = (
+            f"{self._tmp.name}/node{len(self.nodes)}" if self._tmp else None
+        )
+        node = NodeServer(
+            data_dir=data_dir,
+            replica_n=self.nodes[0].cluster.replica_n,
+            n_words=self.nodes[0].holder.n_words,
+            long_query_time=self.nodes[0].server.httpd.RequestHandlerClass.long_query_time,
+        )
+        node.start()
+        try:
+            self.coordinator.resize_coordinator().add_node(node.node_id, node.uri)
+        except Exception:
+            node.stop()
+            raise
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, i: int) -> None:
+        node = self.nodes[i]
+        self.coordinator.resize_coordinator().remove_node(node.node_id)
+        node.stop()
+        self.nodes.pop(i)
+
+    def sync_all(self) -> dict:
+        """Run one anti-entropy pass on every node; returns summed stats."""
+        total: dict[str, int] = {}
+        for n in self.nodes:
+            for k, v in n.syncer().sync_holder().items():
+                total[k] = total.get(k, 0) + v
+        return total
+
     def stop_node(self, i: int) -> None:
         """Hard-stop one node (fault injection — the reference uses pumba
         pause in internal/clustertests)."""

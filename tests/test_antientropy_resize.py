@@ -1,0 +1,207 @@
+"""Anti-entropy sync + elastic resize tests (reference:
+fragment_internal_test.go block/merge tests, server/cluster_test.go
+node-join/resize tests, internal/clustertests fault-injection suite)."""
+
+import numpy as np
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import InProcessCluster
+
+
+def _local_shards(node, index, field, view="standard"):
+    f = node.holder.field(index, field)
+    if f is None:
+        return set()
+    v = f.view(view)
+    return set(v.fragments) if v is not None else set()
+
+
+# -- fragment blocks --------------------------------------------------------
+
+
+def test_fragment_blocks_and_block_data():
+    from pilosa_tpu.core.fragment import Fragment, HASH_BLOCK_SIZE
+
+    frag = Fragment("i", "f", "standard", 0, n_words=64)
+    frag.set_bit(1, 5)
+    frag.set_bit(1, 9)
+    frag.set_bit(HASH_BLOCK_SIZE + 2, 7)  # second block
+    blocks = frag.blocks()
+    assert [b["id"] for b in blocks] == [0, 1]
+    rows, cols = frag.block_data(0)
+    assert list(zip(rows, cols)) == [(1, 5), (1, 9)]
+    rows, cols = frag.block_data(1)
+    assert list(zip(rows, cols)) == [(HASH_BLOCK_SIZE + 2, 7)]
+    # checksums change when bits change
+    before = frag.blocks()[0]["checksum"]
+    frag.set_bit(2, 3)
+    assert frag.blocks()[0]["checksum"] != before
+
+
+# -- anti-entropy -----------------------------------------------------------
+
+
+def test_antientropy_repairs_diverged_replicas():
+    with InProcessCluster(2, replica_n=2) as c:
+        c.create_index("ae")
+        c.create_field("ae", "f")
+        c.import_bits("ae", "f", [(1, 10), (1, SHARD_WIDTH + 4), (2, 77)])
+        # diverge: extra bit written directly on node 0 only (bypasses
+        # replication, simulating a write lost by the other replica)
+        f0 = c.nodes[0].holder.field("ae", "f")
+        shard0 = sorted(_local_shards(c.nodes[0], "ae", "f"))[0]
+        f0.view("standard").fragment(shard0).set_bit(9, 123)
+        n0 = c.nodes[0].holder.fragment("ae", "f", "standard", shard0).total_count()
+        n1 = c.nodes[1].holder.fragment("ae", "f", "standard", shard0).total_count()
+        assert n0 != n1
+        stats = c.sync_all()
+        assert stats["bits_set"] >= 1
+        a = c.nodes[0].holder.fragment("ae", "f", "standard", shard0)
+        b = c.nodes[1].holder.fragment("ae", "f", "standard", shard0)
+        assert a.total_count() == b.total_count()
+        assert b.get_bit(9, 123)
+        # second pass is a no-op
+        stats2 = c.sync_all()
+        assert stats2["bits_set"] == 0 and stats2["bits_cleared"] == 0
+
+
+def test_antientropy_creates_missing_replica_fragment():
+    with InProcessCluster(2, replica_n=2) as c:
+        c.create_index("ae2")
+        c.create_field("ae2", "f")
+        # write directly into node 0's holder only
+        f0 = c.nodes[0].holder.field("ae2", "f")
+        v = f0.create_view_if_not_exists("standard")
+        frag = v.create_fragment_if_not_exists(3)
+        frag.set_bit(0, 42)
+        assert c.nodes[1].holder.fragment("ae2", "f", "standard", 3) is None
+        c.nodes[0].syncer().sync_holder()
+        rep = c.nodes[1].holder.fragment("ae2", "f", "standard", 3)
+        assert rep is not None and rep.get_bit(0, 42)
+
+
+def test_antientropy_schema_sync_heals_missed_broadcast():
+    with InProcessCluster(2, replica_n=1) as c:
+        # create schema ONLY on node 0's holder (as if the broadcast to
+        # node 1 was lost)
+        c.nodes[0].api._create_index("lost", broadcast=False)
+        c.nodes[0].api._create_field("lost", "f", broadcast=False)
+        assert c.nodes[1].holder.index("lost") is None
+        c.nodes[1].syncer().sync_holder()
+        assert c.nodes[1].holder.index("lost") is not None
+        assert c.nodes[1].holder.field("lost", "f") is not None
+
+
+# -- resize -----------------------------------------------------------------
+
+
+def test_resize_add_node_moves_fragments_and_preserves_data():
+    with InProcessCluster(2, replica_n=1) as c:
+        c.create_index("rz")
+        c.create_field("rz", "f")
+        n_shards = 12
+        bits = [(0, s * SHARD_WIDTH + s) for s in range(n_shards)]
+        c.import_bits("rz", "f", bits)
+        assert c.query(0, "rz", "Count(Row(f=0))")["results"][0] == n_shards
+
+        new = c.add_node()
+        # membership propagated everywhere, state NORMAL
+        for n in c.nodes:
+            assert len(n.cluster.nodes) == 3, n.node_id
+            assert n.cluster.state == "NORMAL"
+        # the new node took ownership of some shards and holds exactly them
+        new_shards = _local_shards(new, "rz", "f")
+        assert new_shards, "new node owns no shards (unlucky hash?)"
+        for n in c.nodes:
+            held = _local_shards(n, "rz", "f")
+            owned = {
+                s
+                for s in range(n_shards)
+                if n.cluster.owns_shard(n.node_id, "rz", s)
+            }
+            assert held == owned, f"{n.node_id}: held {held} != owned {owned}"
+        # data survives, queryable from every node
+        for i in range(3):
+            assert c.query(i, "rz", "Count(Row(f=0))")["results"][0] == n_shards
+        cols = c.query(2, "rz", "Row(f=0)")["results"][0]["columns"]
+        assert sorted(cols) == sorted(col for _, col in bits)
+
+
+def test_resize_remove_node_preserves_data():
+    with InProcessCluster(3, replica_n=1) as c:
+        c.create_index("rm")
+        c.create_field("rm", "f")
+        n_shards = 10
+        bits = [(5, s * SHARD_WIDTH) for s in range(n_shards)]
+        c.import_bits("rm", "f", bits)
+        # remove a non-coordinator node (its fragments stream out first)
+        victim = next(
+            i for i, n in enumerate(c.nodes) if n.node_id != c.coordinator_id
+        )
+        c.remove_node(victim)
+        assert len(c.nodes) == 2
+        for n in c.nodes:
+            assert len(n.cluster.nodes) == 2
+            assert n.cluster.state == "NORMAL"
+        for i in range(2):
+            assert c.query(i, "rm", "Count(Row(f=5))")["results"][0] == n_shards
+
+
+def test_resize_transfers_bsi_bit_depth():
+    """An int-field fragment moved by resize must read back correct
+    values on the new owner even though bit depth grew dynamically on
+    the old owner (schema carries only FieldOptions)."""
+    with InProcessCluster(2, replica_n=1) as c:
+        c.create_index("bz")
+        # no min/max: bit_depth starts at 0 and grows with writes
+        c.create_field("bz", "v", {"type": "int", "min": 0, "max": 100000})
+        vals = {s * SHARD_WIDTH + 3: 1000 + s * 77 for s in range(8)}
+        for col, val in vals.items():
+            c.query(0, "bz", f"Set({col}, v={val})")
+        want = sum(vals.values())
+        assert c.query(0, "bz", "Sum(field=v)")["results"][0]["value"] == want
+        c.add_node()
+        for i in range(3):
+            res = c.query(i, "bz", "Sum(field=v)")["results"][0]
+            assert res == {"value": want, "count": len(vals)}, f"node {i}"
+
+
+def test_resize_with_disk_persistence():
+    """Disk-backed cluster: resize moves fragments, dropped fragments'
+    files are deleted, and a queued snapshot cannot resurrect them."""
+    import os
+
+    with InProcessCluster(2, replica_n=1, with_disk=True) as c:
+        c.create_index("dz")
+        c.create_field("dz", "f")
+        c.import_bits("dz", "f", [(0, s * SHARD_WIDTH) for s in range(8)])
+        files_before = {
+            n.node_id: sorted(
+                f for _, _, fs in os.walk(f"{c._tmp.name}/node{i}") for f in fs
+            )
+            for i, n in enumerate(c.nodes)
+        }
+        new = c.add_node()
+        for i in range(3):
+            assert c.query(i, "dz", "Count(Row(f=0))")["results"][0] == 8
+        # every node's on-disk fragments match exactly what it owns
+        for i, n in enumerate(c.nodes):
+            held = _local_shards(n, "dz", "f")
+            frag_dir = f"{c._tmp.name}/node{i}/dz/f/views/standard/fragments"
+            on_disk = (
+                {int(f) for f in os.listdir(frag_dir)}
+                if os.path.isdir(frag_dir)
+                else set()
+            )
+            assert on_disk == held, f"node {i}: disk {on_disk} != held {held}"
+
+
+def test_resize_then_write_then_query():
+    """Writes keep working after a resize (placement fully re-derived)."""
+    with InProcessCluster(2, replica_n=1) as c:
+        c.create_index("rw")
+        c.create_field("rw", "f")
+        c.import_bits("rw", "f", [(1, s * SHARD_WIDTH) for s in range(6)])
+        c.add_node()
+        c.query(0, "rw", f"Set({6 * SHARD_WIDTH + 2}, f=1)")
+        assert c.query(1, "rw", "Count(Row(f=1))")["results"][0] == 7
