@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -225,8 +226,13 @@ class AntiEntropyLoop:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
+            t0 = time.monotonic()
             try:
                 self.syncer.sync_holder()
+                # duration metric (reference server.go:532)
+                self.syncer.holder.stats.timing(
+                    "anti_entropy", time.monotonic() - t0
+                )
             except Exception as e:
                 logger.warning("anti-entropy pass failed: %s", e)
 

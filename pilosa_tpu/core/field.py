@@ -19,6 +19,7 @@ import numpy as np
 from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.attrs import AttrStore
 from pilosa_tpu.core.view import VIEW_STANDARD, View, view_name_bsi
+from pilosa_tpu.obs import stats as stats_mod
 from pilosa_tpu.shardwidth import SHARD_WORDS
 
 FIELD_TYPE_SET = "set"
@@ -130,6 +131,9 @@ class Field:
         # Shards held by OTHER nodes, learned via create-shard broadcasts
         # (reference field.go:263-345 remoteAvailableShards).
         self.remote_available_shards: set[int] = set()
+        # Metrics sink, tagged index:/field: by the creation chain
+        # (reference fragment.go:714 SetBit/ClearBit counts).
+        self.stats = stats_mod.NOP
 
         o = self.options
         if o.field_type == FIELD_TYPE_INT:
@@ -227,6 +231,8 @@ class Field:
                 VIEW_STANDARD, timestamp, o.time_quantum
             ):
                 changed |= self.create_view_if_not_exists(vname).set_bit(row, col)
+        if changed:
+            self.stats.count("set_bit")
         return changed
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -236,6 +242,8 @@ class Field:
         for v in list(self.views.values()):
             if v.name == VIEW_STANDARD or v.name.startswith(VIEW_STANDARD + "_"):
                 changed |= v.clear_bit(row, col)
+        if changed:
+            self.stats.count("clear_bit")
         return changed
 
     def get_bit(self, row: int, col: int) -> bool:
@@ -270,7 +278,10 @@ class Field:
         stored = value - self.base
         self.grow_bit_depth(bit_depth_of(stored))
         view = self.create_view_if_not_exists(self.bsi_view_name())
-        return view.set_value(col, self.bit_depth, stored)
+        changed = view.set_value(col, self.bit_depth, stored)
+        if changed:
+            self.stats.count("set_value")
+        return changed
 
     def value(self, col: int) -> tuple[int, bool]:
         self._check_bsi()
@@ -300,6 +311,7 @@ class Field:
             raise ValueError("import clear is not supported with timestamps")
         rows = np.asarray(list(rows) if not isinstance(rows, np.ndarray) else rows, dtype=np.uint64)
         cols = np.asarray(list(cols) if not isinstance(cols, np.ndarray) else cols, dtype=np.uint64)
+        self.stats.count("import_bits", len(cols))
         width = self.n_words * 32
         shards = cols // width
         offs = cols % width

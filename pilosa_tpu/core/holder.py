@@ -10,6 +10,7 @@ import threading
 
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.core.index import Index
+from pilosa_tpu.obs import stats as stats_mod
 from pilosa_tpu.shardwidth import SHARD_WORDS
 
 
@@ -19,6 +20,16 @@ class Holder:
         self._lock = threading.RLock()
         self.indexes: dict[str, Index] = {}
         self.on_create_index = None
+        # Injected metrics sink (reference holder.go Stats, default nop).
+        self.stats = stats_mod.NOP
+
+    def set_stats(self, client: stats_mod.StatsClient) -> None:
+        """Install a stats client, re-tagging existing indexes/fields the
+        way the reference wires stats at construction (holder.go:112)."""
+        with self._lock:
+            self.stats = client
+            for name, idx in self.indexes.items():
+                idx.set_stats(client.with_tags(f"index:{name}"))
 
     def index(self, name: str) -> Index | None:
         return self.indexes.get(name)
@@ -30,6 +41,7 @@ class Holder:
             if name in self.indexes:
                 raise ValueError(f"index already exists: {name}")
             idx = Index(name, keys=keys, track_existence=track_existence, n_words=self.n_words)
+            idx.set_stats(self.stats.with_tags(f"index:{name}"))
             self.indexes[name] = idx
             if self.on_create_index is not None:
                 self.on_create_index(idx)

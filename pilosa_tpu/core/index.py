@@ -12,6 +12,7 @@ import threading
 
 from pilosa_tpu.core.attrs import AttrStore
 from pilosa_tpu.core.field import Field, FieldOptions, validate_name
+from pilosa_tpu.obs import stats as stats_mod
 from pilosa_tpu.shardwidth import SHARD_WORDS
 
 EXISTENCE_FIELD_NAME = "_exists"
@@ -35,8 +36,15 @@ class Index:
         # column attributes (reference index.go columnAttrs boltdb store)
         self.column_attrs = AttrStore()
         self.on_create_field = None
+        self.stats = stats_mod.NOP
         if track_existence:
             self._create_existence_field()
+
+    def set_stats(self, client) -> None:
+        with self._lock:
+            self.stats = client
+            for name, f in self.fields.items():
+                f.stats = client.with_tags(f"field:{name}")
 
     def _create_existence_field(self) -> Field:
         f = Field(self.name, EXISTENCE_FIELD_NAME, n_words=self.n_words)
@@ -55,6 +63,7 @@ class Index:
             if name in self.fields:
                 raise ValueError(f"field already exists: {name}")
             f = Field(self.name, name, options, self.n_words)
+            f.stats = self.stats.with_tags(f"field:{name}")
             self.fields[name] = f
             if self.on_create_field is not None:
                 self.on_create_field(self, f)

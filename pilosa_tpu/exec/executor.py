@@ -243,6 +243,10 @@ class Executor:
 
     def _execute_call(self, idx: Index, call: Call, shards: list[int] | None) -> Any:
         name = call.name
+        # Per-call-type query counts (reference executor.go:298-339).
+        self.holder.stats.count_with_tags(
+            "query_total", 1, 1.0, (f"index:{idx.name}", f"call:{name}")
+        )
         if name == "Sum":
             return self._execute_sum(idx, call, shards)
         if name == "Min":

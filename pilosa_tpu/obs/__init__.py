@@ -1,0 +1,2 @@
+"""Observability: stats, tracing, diagnostics (reference: stats/,
+tracing/, prometheus/, statsd/, diagnostics.go, gopsutil/, gcnotify/)."""

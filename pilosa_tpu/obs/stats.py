@@ -1,0 +1,232 @@
+"""Stats client (reference: stats/stats.go:31-65 StatsClient interface).
+
+The reference defines a small tagged-metrics interface with pluggable
+backends — expvar (stats/stats.go:84+), statsd/DataDog (statsd/statsd.go:48)
+and Prometheus (prometheus/prometheus.go:52) — selected by the
+``metric.service`` config key (server/server.go:397-411), with
+``NopStatsClient`` as the zero default so instrumented code never
+nil-checks.
+
+Here the in-memory :class:`MemStatsClient` doubles as the expvar backend
+(``/debug/vars`` JSON dump) and the Prometheus backend (text exposition via
+:func:`prometheus_text`, served at ``/metrics`` — reference
+http/handler.go:282). statsd wire output is out of scope (no egress), but
+the interface point where it would plug in is the same.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+
+class StatsClient:
+    """Tagged metrics interface (reference stats/stats.go:31-65)."""
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        raise NotImplementedError
+
+    def count_with_tags(
+        self, name: str, value: int, rate: float, tags: Iterable[str]
+    ) -> None:
+        raise NotImplementedError
+
+    def gauge(self, name: str, value: float) -> None:
+        raise NotImplementedError
+
+    def histogram(self, name: str, value: float) -> None:
+        raise NotImplementedError
+
+    def set_value(self, name: str, value: str) -> None:
+        raise NotImplementedError
+
+    def timing(self, name: str, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class NopStatsClient(StatsClient):
+    """Zero-cost default (reference stats.NopStatsClient)."""
+
+    def count(self, name, value=1, rate=1.0):
+        pass
+
+    def count_with_tags(self, name, value, rate, tags):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+    def histogram(self, name, value):
+        pass
+
+    def set_value(self, name, value):
+        pass
+
+    def timing(self, name, seconds):
+        pass
+
+
+NOP = NopStatsClient()
+
+
+class _Histo:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+        }
+
+
+class MemStatsClient(StatsClient):
+    """Thread-safe in-memory aggregator; the expvar/prometheus backend.
+
+    Tag handling mirrors the reference's Prometheus backend, which turns
+    ``"index:foo"`` tags into ``{index="foo"}`` labels
+    (prometheus/prometheus.go:52+). Keys are (name, sorted-tags).
+    """
+
+    def __init__(self, tags: tuple[str, ...] = ()):
+        self._lock = threading.Lock()
+        self._tags = tuple(sorted(tags))
+        # shared across with_tags children
+        self._counters: dict[tuple[str, tuple[str, ...]], float] = {}
+        self._gauges: dict[tuple[str, tuple[str, ...]], float] = {}
+        self._histograms: dict[tuple[str, tuple[str, ...]], _Histo] = {}
+        self._sets: dict[tuple[str, tuple[str, ...]], set[str]] = {}
+
+    def with_tags(self, *tags: str) -> "MemStatsClient":
+        child = MemStatsClient.__new__(MemStatsClient)
+        child._lock = self._lock
+        child._tags = tuple(sorted(set(self._tags) | set(tags)))
+        child._counters = self._counters
+        child._gauges = self._gauges
+        child._histograms = self._histograms
+        child._sets = self._sets
+        return child
+
+    def _key(self, name: str, extra: Iterable[str] = ()) -> tuple[str, tuple[str, ...]]:
+        if extra:
+            return name, tuple(sorted(set(self._tags) | set(extra)))
+        return name, self._tags
+
+    def count(self, name, value=1, rate=1.0):
+        k = self._key(name)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + value
+
+    def count_with_tags(self, name, value, rate, tags):
+        k = self._key(name, tags)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + value
+
+    def gauge(self, name, value):
+        with self._lock:
+            self._gauges[self._key(name)] = value
+
+    def histogram(self, name, value):
+        k = self._key(name)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                h = self._histograms[k] = _Histo()
+            h.observe(value)
+
+    def set_value(self, name, value):
+        k = self._key(name)
+        with self._lock:
+            self._sets.setdefault(k, set()).add(value)
+
+    def timing(self, name, seconds):
+        self.histogram(name + "_seconds", seconds)
+
+    # -- exposition ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """expvar-style JSON dump (reference ``/debug/vars``)."""
+
+        def label(k):
+            name, tags = k
+            return name if not tags else name + "{" + ",".join(tags) + "}"
+
+        with self._lock:
+            return {
+                "counters": {label(k): v for k, v in self._counters.items()},
+                "gauges": {label(k): v for k, v in self._gauges.items()},
+                "histograms": {
+                    label(k): h.to_dict() for k, h in self._histograms.items()
+                },
+                "sets": {label(k): len(s) for k, s in self._sets.items()},
+            }
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_labels(tags: tuple[str, ...]) -> str:
+    if not tags:
+        return ""
+    parts = []
+    for t in tags:
+        k, _, v = t.partition(":")
+        parts.append(f'{_prom_name(k)}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_text(client: StatsClient) -> str:
+    """Render a MemStatsClient in Prometheus text exposition format
+    (reference prometheus/prometheus.go:52, route http/handler.go:282)."""
+    if not isinstance(client, MemStatsClient):
+        return ""
+    out: list[str] = []
+    with client._lock:
+        counters = dict(client._counters)
+        gauges = dict(client._gauges)
+        histos = {k: (h.count, h.total) for k, h in client._histograms.items()}
+        sets = {k: len(s) for k, s in client._sets.items()}
+    seen: set[str] = set()
+
+    def typ(name: str, t: str) -> None:
+        if name not in seen:
+            seen.add(name)
+            out.append(f"# TYPE {name} {t}")
+
+    for (name, tags), v in sorted(counters.items()):
+        n = "pilosa_" + _prom_name(name)
+        typ(n, "counter")
+        out.append(f"{n}{_prom_labels(tags)} {v}")
+    for (name, tags), v in sorted(gauges.items()):
+        n = "pilosa_" + _prom_name(name)
+        typ(n, "gauge")
+        out.append(f"{n}{_prom_labels(tags)} {v}")
+    for (name, tags), (cnt, total) in sorted(histos.items()):
+        n = "pilosa_" + _prom_name(name)
+        typ(n, "summary")
+        out.append(f"{n}_count{_prom_labels(tags)} {cnt}")
+        out.append(f"{n}_sum{_prom_labels(tags)} {total}")
+    for (name, tags), card in sorted(sets.items()):
+        n = "pilosa_" + _prom_name(name) + "_cardinality"
+        typ(n, "gauge")
+        out.append(f"{n}{_prom_labels(tags)} {card}")
+    return "\n".join(out) + "\n"

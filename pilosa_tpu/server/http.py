@@ -44,6 +44,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/info$"), "info"),
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("POST", re.compile(r"^/schema$"), "post_schema"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
+    ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/export$"), "export"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import$"), "import_"),
@@ -120,6 +122,10 @@ class Handler(BaseHTTPRequestHandler):
                     self._send_json(500, {"error": f"internal: {e}"})
                 finally:
                     elapsed = time.monotonic() - t0
+                    self.api.holder.stats.count_with_tags(
+                        "http_requests", 1, 1.0, (f"route:{name}",)
+                    )
+                    self.api.holder.stats.timing("http_request", elapsed)
                     if self.long_query_time and elapsed > self.long_query_time:
                         logger.warning(
                             "long query %.3fs: %s %s", elapsed, method, self.path
@@ -152,6 +158,22 @@ class Handler(BaseHTTPRequestHandler):
 
     def r_get_schema(self):
         self._send_json(200, self.api.schema())
+
+    def r_metrics(self):
+        """Prometheus text exposition (reference http/handler.go:282)."""
+        from pilosa_tpu.obs.stats import prometheus_text
+
+        self._send(
+            200,
+            prometheus_text(self.api.holder.stats).encode(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    def r_debug_vars(self):
+        """expvar-style dump (reference http/handler.go:281)."""
+        stats = self.api.holder.stats
+        snap = stats.snapshot() if hasattr(stats, "snapshot") else {}
+        self._send_json(200, snap)
 
     def r_post_schema(self):
         self.api.apply_schema(self._json_body())

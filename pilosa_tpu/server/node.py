@@ -34,9 +34,17 @@ class NodeServer:
         replica_n: int = 1,
         n_words: int = SHARD_WORDS,
         long_query_time: float = 0.0,
+        stats_client=None,
     ):
         self.host = host
         self.holder = Holder(n_words)
+        # Metrics backend; MemStatsClient serves /metrics + /debug/vars
+        # (reference server.go:397-411 metric.service selection).
+        from pilosa_tpu.obs.stats import MemStatsClient
+
+        self.holder.set_stats(
+            stats_client if stats_client is not None else MemStatsClient()
+        )
         self.store = None
         if data_dir is not None:
             self.store = HolderStore(self.holder, data_dir)

@@ -1,0 +1,149 @@
+"""Stats subsystem tests (reference: stats/stats_test.go, prometheus/,
+http/handler.go:281-282 expvar + /metrics routes)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.obs.stats import (
+    NOP,
+    MemStatsClient,
+    NopStatsClient,
+    prometheus_text,
+)
+
+
+def test_mem_counters_and_tags():
+    s = MemStatsClient()
+    s.count("ops")
+    s.count("ops", 4)
+    tagged = s.with_tags("index:i")
+    tagged.count("ops")
+    snap = s.snapshot()
+    assert snap["counters"]["ops"] == 5
+    assert snap["counters"]["ops{index:i}"] == 1
+
+
+def test_with_tags_shares_storage_and_merges():
+    s = MemStatsClient()
+    a = s.with_tags("index:i")
+    b = a.with_tags("field:f")
+    b.count("set_bit")
+    snap = s.snapshot()
+    assert snap["counters"]["set_bit{field:f,index:i}"] == 1
+
+
+def test_gauge_histogram_set():
+    s = MemStatsClient()
+    s.gauge("goroutines", 12)
+    s.timing("snapshot", 0.5)
+    s.timing("snapshot", 1.5)
+    s.set_value("index", "foo")
+    s.set_value("index", "foo")
+    s.set_value("index", "bar")
+    snap = s.snapshot()
+    assert snap["gauges"]["goroutines"] == 12
+    h = snap["histograms"]["snapshot_seconds"]
+    assert h["count"] == 2 and h["sum"] == 2.0 and h["min"] == 0.5 and h["max"] == 1.5
+    assert snap["sets"]["index"] == 2
+
+
+def test_prometheus_text_rendering():
+    s = MemStatsClient()
+    s.with_tags("index:i", "field:f").count("set_bit", 3)
+    s.gauge("maps", 7)
+    s.timing("query", 0.25)
+    text = prometheus_text(s)
+    assert '# TYPE pilosa_set_bit counter' in text
+    assert 'pilosa_set_bit{field="f",index="i"} 3' in text
+    assert "pilosa_maps 7" in text
+    assert "pilosa_query_seconds_count 1" in text
+    assert prometheus_text(NOP) == ""
+
+
+def test_nop_interface_complete():
+    n = NopStatsClient()
+    n.count("x")
+    n.count_with_tags("x", 1, 1.0, ["a:b"])
+    n.gauge("x", 1)
+    n.histogram("x", 1)
+    n.set_value("x", "v")
+    n.timing("x", 1)
+    assert n.with_tags("a:b") is n
+
+
+def test_holder_wires_stats_through_creation_chain():
+    h = Holder()
+    mem = MemStatsClient()
+    h.set_stats(mem)
+    idx = h.create_index("i", track_existence=False)
+    f = idx.create_field("f")
+    f.set_bit(1, 1)
+    f.set_bit(1, 1)  # unchanged, not counted
+    f.clear_bit(1, 1)
+    snap = mem.snapshot()
+    assert snap["counters"]["set_bit{field:f,index:i}"] == 1
+    assert snap["counters"]["clear_bit{field:f,index:i}"] == 1
+
+
+def test_set_stats_retags_existing_indexes():
+    h = Holder()
+    idx = h.create_index("i", track_existence=False)
+    f = idx.create_field("f")
+    mem = MemStatsClient()
+    h.set_stats(mem)  # after creation — must re-tag
+    f.set_bit(0, 0)
+    assert mem.snapshot()["counters"]["set_bit{field:f,index:i}"] == 1
+
+
+def test_executor_query_counts():
+    h = Holder()
+    mem = MemStatsClient()
+    h.set_stats(mem)
+    idx = h.create_index("i", track_existence=False)
+    idx.create_field("f").set_bit(1, 2)
+    ex = Executor(h)
+    ex.execute("i", 'Count(Row(f=1))')
+    ex.execute("i", 'Row(f=1)')
+    snap = mem.snapshot()
+    # Only top-level calls are counted, matching the reference where
+    # nested bitmap calls go through executeBitmapCallShard, not
+    # executeCall (executor.go:298-339, :653-680).
+    assert snap["counters"]["query_total{call:Count,index:i}"] == 1
+    assert snap["counters"]["query_total{call:Row,index:i}"] == 1
+
+
+def test_http_metrics_and_debug_vars(tmp_path):
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(port=0)
+    node.start()
+    try:
+        base = node.uri
+        node.api.create_index("i")
+        node.api.create_field("i", "f")
+        # go through HTTP so http_requests is exercised
+        req = urllib.request.Request(
+            base + "/index/i/query", data=b"Set(5, f=1)", method="POST"
+        )
+        urllib.request.urlopen(req).read()
+        # request counters fire after the response bytes are sent, so a
+        # fetch on another connection can race them — poll briefly
+        text = ""
+        for _ in range(100):
+            with urllib.request.urlopen(base + "/metrics") as r:
+                text = r.read().decode()
+            if "pilosa_http_requests" in text:
+                break
+            time.sleep(0.02)
+        assert "pilosa_set_bit" in text
+        assert "pilosa_http_requests" in text
+        with urllib.request.urlopen(base + "/debug/vars") as r:
+            snap = json.loads(r.read())
+        assert any(k.startswith("set_bit") for k in snap["counters"])
+    finally:
+        node.stop()
