@@ -60,9 +60,17 @@ class HolderSyncer:
                             self.cluster.node_id, index_name, shard
                         ):
                             continue
-                        self.sync_fragment(
-                            index_name, fname, vname, shard, stats
-                        )
+                        # One bad fragment must not abort the whole pass —
+                        # the loop retries next interval anyway.
+                        try:
+                            self.sync_fragment(
+                                index_name, fname, vname, shard, stats
+                            )
+                        except Exception as e:
+                            logger.warning(
+                                "sync of %s/%s/%s/%d failed: %s",
+                                index_name, fname, vname, shard, e,
+                            )
                         stats["fragments"] += 1
         return stats
 
@@ -126,21 +134,27 @@ class HolderSyncer:
             stats["blocks_diff"] += 1
             self._merge_block(
                 index, field, view, shard, bid, frag, replicas,
-                remote_blocks, stats,
+                remote_blocks, stats, local_blocks.get(bid),
             )
 
     def _merge_block(
         self, index, field, view, shard, block, frag, replicas,
-        remote_blocks, stats,
+        remote_blocks, stats, local_sum=None,
     ) -> None:
         """Majority-consensus merge of one block (reference
         fragment.go:1873-1991 mergeBlock + syncBlock :2900-3011)."""
         pair_sets: dict[str, set[tuple[int, int]]] = {}
         lrows, lcols = frag.block_data(block)
-        pair_sets[self.cluster.node_id] = set(zip(lrows, lcols))
+        local_pairs = set(zip(lrows, lcols))
+        pair_sets[self.cluster.node_id] = local_pairs
         for node in replicas:
             if node.id not in remote_blocks:
                 continue  # unreachable earlier; skip from consensus
+            # Matching checksum ⇒ identical pair set; skip the fetch
+            # (only blocks differing from SOME replica reach here).
+            if local_sum is not None and remote_blocks[node.id].get(block) == local_sum:
+                pair_sets[node.id] = local_pairs
+                continue
             try:
                 data = self.client.block_data(
                     node.uri, index, field, view, shard, block
@@ -166,15 +180,19 @@ class HolderSyncer:
             to_clear = have - keep
             if not to_set and not to_clear:
                 continue
-            stats["bits_set"] += len(to_set)
-            stats["bits_cleared"] += len(to_clear)
             if node_id == self.cluster.node_id:
                 self._apply_local(frag, to_set, to_clear)
+                stats["bits_set"] += len(to_set)
+                stats["bits_cleared"] += len(to_clear)
             else:
                 node = self.cluster.node(node_id)
-                self._push_remote(
+                # count only bits actually shipped (the wire format may
+                # drop unencodable rows)
+                n_set, n_clear = self._push_remote(
                     node, index, field, view, shard, frag, to_set, to_clear
                 )
+                stats["bits_set"] += n_set
+                stats["bits_cleared"] += n_clear
 
     def _apply_local(self, frag, to_set, to_clear) -> None:
         if to_set:
@@ -194,21 +212,36 @@ class HolderSyncer:
         from pilosa_tpu.storage import roaring
 
         width = frag.shard_width
+        # The wire format is uint64 positions (row*width + col), so rows
+        # beyond 2^64/width are unrepresentable — skip them rather than
+        # abort the pass (arbitrary uint64 row ids are legal locally).
+        max_row = (2**64 - 1 - (width - 1)) // width
+        shipped = [0, 0]
         try:
-            for pairs, clear in ((to_set, False), (to_clear, True)):
+            for i, (pairs, clear) in enumerate(((to_set, False), (to_clear, True))):
                 if not pairs:
+                    continue
+                encodable = [(r, c) for r, c in pairs if r <= max_row]
+                if len(encodable) != len(pairs):
+                    logger.warning(
+                        "skipping %d bits with row ids too large for the "
+                        "position wire format", len(pairs) - len(encodable),
+                    )
+                if not encodable:
                     continue
                 positions = np.sort(
                     np.array(
-                        [r * width + c for r, c in pairs], dtype=np.uint64
+                        [r * width + c for r, c in encodable], dtype=np.uint64
                     )
                 )
                 self.client.import_roaring(
                     node.uri, index, field, shard,
                     roaring.serialize(positions), clear=clear, view=view,
                 )
+                shipped[i] = len(encodable)
         except ClientError as e:
             logger.warning("sync push to %s failed: %s", node.id, e)
+        return shipped[0], shipped[1]
 
 
 class AntiEntropyLoop:

@@ -29,6 +29,7 @@ On failure the coordinator broadcasts an abort: old membership + NORMAL
 from __future__ import annotations
 
 import logging
+import time
 
 from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.client import ClientError
@@ -216,9 +217,23 @@ class ResizeCoordinator:
             if n.id == self.cluster.node_id:
                 self.api.receive_message(status)
             else:
-                try:
-                    self.client.send_message(n.uri, status)
-                except ClientError as e:
-                    # A removed node that is already gone is expected here.
-                    if n.id in member_ids:
-                        logger.warning("commit to %s failed: %s", n.id, e)
+                # A surviving member that misses the commit would be stuck
+                # in RESIZING forever (503 on all traffic), so retry with
+                # backoff; removed nodes that are already gone get one try.
+                attempts = 5 if n.id in member_ids else 1
+                for attempt in range(attempts):
+                    try:
+                        self.client.send_message(n.uri, status)
+                        break
+                    except ClientError as e:
+                        if n.id not in member_ids:
+                            break  # already-gone removed node: expected
+                        if attempt + 1 < attempts:
+                            time.sleep(0.2 * 2**attempt)
+                        else:
+                            logger.error(
+                                "commit to %s failed after %d attempts: %s "
+                                "(node left in RESIZING; re-send the cluster "
+                                "status or restart it to recover)",
+                                n.id, attempts, e,
+                            )

@@ -538,13 +538,14 @@ class API:
 
     # -- cluster/info (reference api.go:1114-1342) --------------------------
 
+    def _nodes_info(self) -> list[dict]:
+        if self.cluster is not None:
+            return self.cluster.nodes_info()
+        return [{"id": self._node_id(), "uri": "", "isCoordinator": True, "state": "READY"}]
+
     def status(self) -> dict:
         self._validate("Status")
-        nodes = (
-            self.cluster.nodes_info()
-            if self.cluster is not None
-            else [{"id": self._node_id(), "uri": "", "isCoordinator": True, "state": "READY"}]
-        )
+        nodes = self._nodes_info()
         # schema rides along for peer status exchange (the reference's
         # NodeStatus carries schema on gossip push/pull, gossip.go:321-357).
         return {
@@ -566,7 +567,8 @@ class API:
 
     def hosts(self) -> list[dict]:
         self._validate("Hosts")
-        return self.status()["nodes"]
+        # Membership only — skip status()'s full schema/shard-map build.
+        return self._nodes_info()
 
     def shards_max(self) -> dict:
         """reference api.go MaxShards /internal/shards/max."""

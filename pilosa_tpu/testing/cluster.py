@@ -27,6 +27,9 @@ class InProcessCluster:
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
+        # Monotonic so a node added after a removal never reuses a live
+        # node's data dir (dirs are keyed by birth order, not list index).
+        self._next_node_num = n
         for i in range(n):
             data_dir = f"{self._tmp.name}/node{i}" if self._tmp else None
             node = NodeServer(
@@ -96,8 +99,9 @@ class InProcessCluster:
         """Boot a fresh node and resize it into the cluster through the
         coordinator (reference server/cluster_test.go node-join tests)."""
         data_dir = (
-            f"{self._tmp.name}/node{len(self.nodes)}" if self._tmp else None
+            f"{self._tmp.name}/node{self._next_node_num}" if self._tmp else None
         )
+        self._next_node_num += 1
         node = NodeServer(
             data_dir=data_dir,
             replica_n=self.nodes[0].cluster.replica_n,

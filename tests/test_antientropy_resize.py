@@ -205,3 +205,24 @@ def test_resize_then_write_then_query():
         c.add_node()
         c.query(0, "rw", f"Set({6 * SHARD_WIDTH + 2}, f=1)")
         assert c.query(1, "rw", "Count(Row(f=1))")["results"][0] == 7
+
+
+def test_antientropy_survives_unencodable_row_ids():
+    """Rows beyond 2^64/shard_width can't ride the uint64 position wire
+    format; the sync must skip them (warning) instead of aborting the
+    whole pass with an OverflowError."""
+    with InProcessCluster(2, replica_n=2) as c:
+        c.create_index("big")
+        c.create_field("big", "f")
+        c.import_bits("big", "f", [(1, 10), (2, 20)])
+        huge_row = 2**63  # > (2^64-1)/shard_width for any width >= 2
+        f0 = c.nodes[0].holder.field("big", "f")
+        shard0 = sorted(_local_shards(c.nodes[0], "big", "f"))[0]
+        frag0 = f0.view("standard").fragment(shard0)
+        frag0.set_bit(huge_row, 3)
+        frag0.set_bit(9, 123)  # encodable divergence in the same pass
+        stats = c.sync_all()
+        # the encodable bit still converged
+        b = c.nodes[1].holder.fragment("big", "f", "standard", shard0)
+        assert b.get_bit(9, 123)
+        assert stats["bits_set"] >= 1
