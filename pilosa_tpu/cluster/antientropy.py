@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.obs import tracing
 
 logger = logging.getLogger("pilosa_tpu.antientropy")
 
@@ -44,34 +45,36 @@ class HolderSyncer:
         stats = {"fragments": 0, "blocks_diff": 0, "bits_set": 0, "bits_cleared": 0}
         if len(self.cluster.nodes) <= 1:
             return stats
-        self.sync_schema()
-        for index_name in list(self.holder.index_names()):
-            idx = self.holder.index(index_name)
-            if idx is None:
-                continue
-            for fname in idx.field_names(include_internal=True):
-                field = idx.field(fname)
-                if field is None:
+        # span per pass (reference holder.go:683 SyncHolder spans)
+        with tracing.start_span("holderSyncer.SyncHolder"):
+            self.sync_schema()
+            for index_name in list(self.holder.index_names()):
+                idx = self.holder.index(index_name)
+                if idx is None:
                     continue
-                for vname in field.view_names():
-                    view = field.view(vname)
-                    for shard in sorted(view.fragments):
-                        if not self.cluster.owns_shard(
-                            self.cluster.node_id, index_name, shard
-                        ):
-                            continue
-                        # One bad fragment must not abort the whole pass —
-                        # the loop retries next interval anyway.
-                        try:
-                            self.sync_fragment(
-                                index_name, fname, vname, shard, stats
-                            )
-                        except Exception as e:
-                            logger.warning(
-                                "sync of %s/%s/%s/%d failed: %s",
-                                index_name, fname, vname, shard, e,
-                            )
-                        stats["fragments"] += 1
+                for fname in idx.field_names(include_internal=True):
+                    field = idx.field(fname)
+                    if field is None:
+                        continue
+                    for vname in field.view_names():
+                        view = field.view(vname)
+                        for shard in sorted(view.fragments):
+                            if not self.cluster.owns_shard(
+                                self.cluster.node_id, index_name, shard
+                            ):
+                                continue
+                            # One bad fragment must not abort the whole
+                            # pass — the loop retries next interval anyway.
+                            try:
+                                self.sync_fragment(
+                                    index_name, fname, vname, shard, stats
+                                )
+                            except Exception as e:
+                                logger.warning(
+                                    "sync of %s/%s/%s/%d failed: %s",
+                                    index_name, fname, vname, shard, e,
+                                )
+                            stats["fragments"] += 1
         return stats
 
     def sync_schema(self) -> None:
@@ -206,9 +209,10 @@ class HolderSyncer:
 
     def _push_remote(
         self, node, index, field, view, shard, frag, to_set, to_clear
-    ) -> None:
+    ) -> tuple[int, int]:
         """Ship diffs as roaring batches (the reference pushes syncs
-        through ImportRoaring too, fragment.go:2975-3011)."""
+        through ImportRoaring too, fragment.go:2975-3011). Returns the
+        (set, clear) counts actually shipped."""
         from pilosa_tpu.storage import roaring
 
         width = frag.shard_width

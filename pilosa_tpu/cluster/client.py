@@ -14,6 +14,8 @@ import urllib.error
 import urllib.request
 from typing import Any
 
+from pilosa_tpu.obs import tracing
+
 
 class ClientError(Exception):
     def __init__(self, msg: str, code: int = 0):
@@ -40,6 +42,14 @@ class InternalClient:
         )
         if body is not None:
             req.add_header("Content-Type", content_type)
+        # Propagate the active trace across the node boundary (reference
+        # tracing/opentracing.go:58-66 InjectHTTPHeaders).
+        span = tracing.active_span()
+        if span is not None:
+            hdrs: dict = {}
+            tracing.get_tracer().inject_headers(span.context, hdrs)
+            for k, v in hdrs.items():
+                req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.read()

@@ -29,6 +29,7 @@ from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
 from pilosa_tpu.cluster.wire import decode_results
 from pilosa_tpu.exec.executor import ExecuteError, Executor, IndexNotFoundError
 from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
+from pilosa_tpu.obs import tracing
 from pilosa_tpu.pql.ast import Call
 
 # Calls whose result is a Row bitmap (reference executeBitmapCallShard
@@ -75,15 +76,18 @@ class DistributedExecutor:
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name}")
         q = pql.parse(query) if isinstance(query, str) else query
-        results = []
-        for call in q.calls:
-            tcall = call.clone()
-            self.local._translate_call(idx, tcall)
-            results.append(self._execute_call(index_name, idx, tcall, shards))
-        return [
-            self.local._translate_result(idx, c, r)
-            for c, r in zip(q.calls, results)
-        ]
+        # coordinator-side span (reference executor.go:117); remote fan-out
+        # joins it via injected headers in InternalClient._do
+        with tracing.start_span("executor.Execute").set_tag("index", index_name):
+            results = []
+            for call in q.calls:
+                tcall = call.clone()
+                self.local._translate_call(idx, tcall)
+                results.append(self._execute_call(index_name, idx, tcall, shards))
+            return [
+                self.local._translate_result(idx, c, r)
+                for c, r in zip(q.calls, results)
+            ]
 
     def execute_remote(
         self, index_name: str, query: str | pql.Query, shards: list[int] | None
@@ -170,30 +174,33 @@ class DistributedExecutor:
         self, index_name: str, idx, call: Call, shards: list[int]
     ) -> Any:
         pql_text = str(call)
-        bad_nodes: set[str] = set()
-        partials: list[Any] = []
-        pending = list(shards)
-        while pending:
-            groups = self._group_by_live_owner(index_name, pending, bad_nodes)
-            pending = []
-            for node_id, nshards in groups.items():
-                node = self.cluster.node(node_id)
-                if node_id == self.cluster.node_id:
-                    partials.append(self.local._execute_call(idx, call, nshards))
-                    continue
-                try:
-                    wire = self.client.query_node(
-                        node.uri, index_name, pql_text, nshards
-                    )
-                    partials.append(decode_results(wire)[0])
-                except ClientError:
-                    # Failover: re-map this node's shards onto remaining
-                    # replicas (reference executor.go:2495-2506).
-                    bad_nodes.add(node_id)
-                    pending.extend(nshards)
-        if not partials:
-            partials = [self.local._execute_call(idx, call, [])]
-        return _reduce(call, partials)
+        span = tracing.start_span("executor.mapReduce").set_tag("call", call.name)
+        span.set_tag("shards", len(shards))
+        with span:
+            bad_nodes: set[str] = set()
+            partials: list[Any] = []
+            pending = list(shards)
+            while pending:
+                groups = self._group_by_live_owner(index_name, pending, bad_nodes)
+                pending = []
+                for node_id, nshards in groups.items():
+                    node = self.cluster.node(node_id)
+                    if node_id == self.cluster.node_id:
+                        partials.append(self.local._execute_call(idx, call, nshards))
+                        continue
+                    try:
+                        wire = self.client.query_node(
+                            node.uri, index_name, pql_text, nshards
+                        )
+                        partials.append(decode_results(wire)[0])
+                    except ClientError:
+                        # Failover: re-map this node's shards onto remaining
+                        # replicas (reference executor.go:2495-2506).
+                        bad_nodes.add(node_id)
+                        pending.extend(nshards)
+            if not partials:
+                partials = [self.local._execute_call(idx, call, [])]
+            return _reduce(call, partials)
 
     def _group_by_live_owner(
         self, index_name: str, shards: list[int], bad_nodes: set[str]

@@ -213,27 +213,33 @@ class ResizeCoordinator:
         if shard_map:
             status["availableShards"] = shard_map
         member_ids = {n.id for n in members}
+        # First sweep: one attempt per node, so a slow/dead node can't
+        # head-of-line-block healthy members' exit from RESIZING.
+        retry: list = []
         for n in all_nodes:
             if n.id == self.cluster.node_id:
                 self.api.receive_message(status)
-            else:
-                # A surviving member that misses the commit would be stuck
-                # in RESIZING forever (503 on all traffic), so retry with
-                # backoff; removed nodes that are already gone get one try.
-                attempts = 5 if n.id in member_ids else 1
-                for attempt in range(attempts):
-                    try:
-                        self.client.send_message(n.uri, status)
-                        break
-                    except ClientError as e:
-                        if n.id not in member_ids:
-                            break  # already-gone removed node: expected
-                        if attempt + 1 < attempts:
-                            time.sleep(0.2 * 2**attempt)
-                        else:
-                            logger.error(
-                                "commit to %s failed after %d attempts: %s "
-                                "(node left in RESIZING; re-send the cluster "
-                                "status or restart it to recover)",
-                                n.id, attempts, e,
-                            )
+                continue
+            try:
+                self.client.send_message(n.uri, status)
+            except ClientError:
+                # A removed node that is already gone is expected; a
+                # surviving member missing the commit would be stuck in
+                # RESIZING forever (503 on all traffic), so retry below.
+                if n.id in member_ids:
+                    retry.append(n)
+        for n in retry:
+            for attempt in range(4):
+                try:
+                    self.client.send_message(n.uri, status)
+                    break
+                except ClientError as e:
+                    if attempt < 3:
+                        time.sleep(0.2 * 2**attempt)
+                    else:
+                        logger.error(
+                            "commit to %s failed after %d attempts: %s "
+                            "(node left in RESIZING; re-send the cluster "
+                            "status or restart it to recover)",
+                            n.id, attempt + 2, e,
+                        )

@@ -20,6 +20,7 @@ from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.attrs import AttrStore
 from pilosa_tpu.core.view import VIEW_STANDARD, View, view_name_bsi
 from pilosa_tpu.obs import stats as stats_mod
+from pilosa_tpu.obs import tracing
 from pilosa_tpu.shardwidth import SHARD_WORDS
 
 FIELD_TYPE_SET = "set"
@@ -312,30 +313,35 @@ class Field:
         rows = np.asarray(list(rows) if not isinstance(rows, np.ndarray) else rows, dtype=np.uint64)
         cols = np.asarray(list(cols) if not isinstance(cols, np.ndarray) else cols, dtype=np.uint64)
         self.stats.count("import_bits", len(cols))
-        width = self.n_words * 32
-        shards = cols // width
-        offs = cols % width
-        std = None if self.options.no_standard_view else self.create_view_if_not_exists(VIEW_STANDARD)
-        for shard in np.unique(shards):
-            m = shards == shard
-            if std is not None:
-                frag = std.create_fragment_if_not_exists(int(shard))
-                if self.field_type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL) and not clear:
-                    for r, c in zip(rows[m], offs[m]):
-                        frag.set_mutex(int(r), int(c))
-                else:
-                    frag.import_bits(rows[m], offs[m].astype(np.int64), clear=clear)
-        if timestamps is not None:
-            ts_arr = list(timestamps)
-            for i, ts in enumerate(ts_arr):
-                if ts is None:
-                    continue
-                for vname in timequantum.views_by_time(
-                    VIEW_STANDARD, ts, self.options.time_quantum
-                ):
-                    self.create_view_if_not_exists(vname).set_bit(
-                        int(rows[i]), int(cols[i])
-                    )
+        # import span (reference fragment.go:2245-2277)
+        span = tracing.start_span("field.Import")
+        span.set_tag("index", self.index).set_tag("field", self.name)
+        span.set_tag("bits", int(len(cols)))
+        with span:
+            width = self.n_words * 32
+            shards = cols // width
+            offs = cols % width
+            std = None if self.options.no_standard_view else self.create_view_if_not_exists(VIEW_STANDARD)
+            for shard in np.unique(shards):
+                m = shards == shard
+                if std is not None:
+                    frag = std.create_fragment_if_not_exists(int(shard))
+                    if self.field_type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL) and not clear:
+                        for r, c in zip(rows[m], offs[m]):
+                            frag.set_mutex(int(r), int(c))
+                    else:
+                        frag.import_bits(rows[m], offs[m].astype(np.int64), clear=clear)
+            if timestamps is not None:
+                ts_arr = list(timestamps)
+                for i, ts in enumerate(ts_arr):
+                    if ts is None:
+                        continue
+                    for vname in timequantum.views_by_time(
+                        VIEW_STANDARD, ts, self.options.time_quantum
+                    ):
+                        self.create_view_if_not_exists(vname).set_bit(
+                            int(rows[i]), int(cols[i])
+                        )
 
     def import_values(self, cols: Iterable[int], values: Iterable[int], clear: bool = False) -> None:
         self._check_bsi()

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu import pql
 from pilosa_tpu.core import timequantum
+from pilosa_tpu.obs import tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
     FIELD_TYPE_INT,
@@ -83,12 +84,17 @@ class Executor:
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name}")
         q = pql.parse(query) if isinstance(query, str) else query
-        results = []
-        for call in q.calls:
-            call = call.clone()
-            self._translate_call(idx, call)
-            results.append(self._execute_call(idx, call, shards))
-        return [self._translate_result(idx, c, r) for c, r in zip(q.calls, results)]
+        # span per query (reference executor.go:117 "Executor.Execute")
+        with tracing.start_span("executor.Execute").set_tag("index", index_name):
+            results = []
+            for call in q.calls:
+                call = call.clone()
+                self._translate_call(idx, call)
+                with tracing.start_span(f"executor.execute{call.name}"):
+                    results.append(self._execute_call(idx, call, shards))
+            return [
+                self._translate_result(idx, c, r) for c, r in zip(q.calls, results)
+            ]
 
     # ------------------------------------------------------- key translation
 

@@ -1,0 +1,164 @@
+"""Distributed tracing (reference: tracing/tracing.go:22-50 Tracer/Span
+interface + global tracer, tracing/opentracing/opentracing.go:31-76
+Jaeger adapter with HTTP header inject/extract for cross-node traces).
+
+The reference instruments ~80 spans across the executor, fragment
+imports, API, and syncers via ``tracing.StartSpanFromContext``. Here the
+active span is carried in a ``contextvars.ContextVar`` (the Python
+analogue of ctx-carried spans), with explicit header inject/extract at
+the node boundary so a query fanned out over HTTP appears as one trace:
+
+    coordinator: api.query span  ─ inject → X-Trace-Id/X-Span-Id headers
+    remote node: extract → handler span (child, same trace id)
+
+Backends: :class:`NopTracer` (zero-cost default, like the reference's
+default no-op tracer) and :class:`RecordingTracer` (in-process ring
+buffer — the stand-in for the Jaeger agent exporter, which needs
+network egress; spans can be dumped for offline analysis).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+
+TRACE_HEADER = "X-Pilosa-Trace-Id"
+SPAN_HEADER = "X-Pilosa-Span-Id"
+
+_ids = itertools.count(1)
+_active_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "pilosa_active_span", default=None
+)
+
+
+class SpanContext:
+    """Wire-propagatable identity of a span."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class Span:
+    """One timed operation (reference tracing.Span :44-50)."""
+
+    def __init__(self, tracer: "Tracer", name: str, parent: SpanContext | None):
+        self.tracer = tracer
+        self.name = name
+        self.parent_id = parent.span_id if parent else 0
+        trace_id = parent.trace_id if parent else next(_ids)
+        self.context = SpanContext(trace_id, next(_ids))
+        self.start = time.monotonic()
+        self.duration = None
+        self.tags: dict = {}
+        self._token = None
+
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def log_kv(self, **fields) -> None:
+        self.tags.setdefault("logs", []).append((time.monotonic(), fields))
+
+    def finish(self) -> None:
+        if self.duration is None:
+            self.duration = time.monotonic() - self.start
+            self.tracer._record(self)
+
+    # context-manager + ambient-activation protocol
+    def __enter__(self) -> "Span":
+        self._token = _active_span.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _active_span.reset(self._token)
+            self._token = None
+        self.finish()
+
+
+class Tracer:
+    """reference tracing.Tracer :32-41."""
+
+    def start_span(
+        self, name: str, child_of: SpanContext | None = None
+    ) -> Span:
+        if child_of is None:
+            parent = _active_span.get()
+            child_of = parent.context if parent is not None else None
+        return Span(self, name, child_of)
+
+    def inject_headers(self, ctx: SpanContext, headers: dict) -> None:
+        """opentracing.go:58-66 InjectHTTPHeaders."""
+        headers[TRACE_HEADER] = str(ctx.trace_id)
+        headers[SPAN_HEADER] = str(ctx.span_id)
+
+    def extract_headers(self, headers) -> SpanContext | None:
+        """opentracing.go:68-76 ExtractHTTPHeaders."""
+        trace_id = headers.get(TRACE_HEADER)
+        span_id = headers.get(SPAN_HEADER)
+        if not trace_id or not span_id:
+            return None
+        try:
+            return SpanContext(int(trace_id), int(span_id))
+        except ValueError:
+            return None
+
+    def _record(self, span: Span) -> None:
+        pass
+
+
+class NopTracer(Tracer):
+    pass
+
+
+class RecordingTracer(Tracer):
+    """Ring-buffer recorder (Jaeger-exporter stand-in)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self.spans: deque[Span] = deque(maxlen=capacity)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+    def finished(self, name: str | None = None) -> list[Span]:
+        with self._lock:
+            return [s for s in self.spans if name is None or s.name == name]
+
+    def traces(self) -> dict[int, list[Span]]:
+        """Finished spans grouped by trace id, in finish order."""
+        with self._lock:
+            out: dict[int, list[Span]] = {}
+            for s in self.spans:
+                out.setdefault(s.context.trace_id, []).append(s)
+            return out
+
+
+# Global tracer (reference tracing.GlobalTracer :22-29).
+_global = Tracer.__new__(NopTracer)  # type: ignore[assignment]
+
+
+def get_tracer() -> Tracer:
+    return _global
+
+
+def set_tracer(t: Tracer) -> None:
+    global _global
+    _global = t
+
+
+def start_span(name: str, child_of: SpanContext | None = None) -> Span:
+    """reference tracing.StartSpanFromContext — ambient parenting via the
+    context variable when ``child_of`` is not given."""
+    return _global.start_span(name, child_of)
+
+
+def active_span() -> Span | None:
+    return _active_span.get()

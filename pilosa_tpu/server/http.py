@@ -33,6 +33,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu.obs import tracing
 from pilosa_tpu.server.api import API, ApiError
 
 logger = logging.getLogger("pilosa_tpu.http")
@@ -111,8 +112,14 @@ class Handler(BaseHTTPRequestHandler):
             match = rx.match(parsed.path)
             if match:
                 t0 = time.monotonic()
+                # Join an incoming cross-node trace, or root a new one
+                # (reference http/handler.go extracts opentracing headers).
+                parent = tracing.get_tracer().extract_headers(self.headers)
+                span = tracing.start_span(f"http.{name}", child_of=parent)
+                span.set_tag("method", method).set_tag("path", parsed.path)
                 try:
-                    getattr(self, "r_" + name)(**match.groupdict())
+                    with span:
+                        getattr(self, "r_" + name)(**match.groupdict())
                 except ApiError as e:
                     self._send_json(e.code, {"error": str(e)})
                 except BrokenPipeError:
