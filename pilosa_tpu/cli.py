@@ -28,7 +28,7 @@ DEFAULT_CONFIG = {
     "long-query-time": 0.0,
     "cluster": {"replicas": 1, "coordinator": True, "hosts": []},
     "anti-entropy": {"interval": 600},
-    "metric": {"service": "none", "poll-interval": 60},
+    "metric": {"service": "none", "poll-interval": 60, "diagnostics-sink": ""},
     "tracing": {"enabled": False},
 }
 
@@ -82,10 +82,8 @@ def _ensure_backend() -> None:
 
 def cmd_server(args) -> int:
     _ensure_backend()
-    from pilosa_tpu.core.holder import Holder
-    from pilosa_tpu.server.api import API
-    from pilosa_tpu.server.http import Server
-    from pilosa_tpu.storage.disk import HolderStore
+    from pilosa_tpu.obs.stats import MemStatsClient, NOP
+    from pilosa_tpu.server.node import NodeServer
 
     cfg = _load_config(args.config)
     data_dir = os.path.expanduser(args.data_dir or cfg["data-dir"])
@@ -93,20 +91,36 @@ def cmd_server(args) -> int:
     host, _, port = bind.rpartition(":")
     host = host or "localhost"
 
-    holder = Holder()
-    store = HolderStore(holder, data_dir)
-    store.open()
-    api = API(holder, store)
-    server = Server(
-        api, host=host, port=int(port), long_query_time=float(cfg["long-query-time"])
+    # metric.service selects the backend (reference server.go:397-411);
+    # "none" keeps the zero-cost nop client.
+    metric_cfg = cfg.get("metric", {})
+    stats_client = NOP if metric_cfg.get("service", "none") == "none" else MemStatsClient()
+    node = NodeServer(
+        data_dir=data_dir,
+        host=host,
+        port=int(port),
+        replica_n=int(cfg.get("cluster", {}).get("replicas", 1)),
+        long_query_time=float(cfg["long-query-time"]),
+        stats_client=stats_client,
+        metric_poll_interval=float(metric_cfg.get("poll-interval", 10) or 10),
     )
-    print(f"pilosa-tpu server listening on http://{host}:{server.port}, data dir {data_dir}")
+    # Periodic diagnostics flushes need somewhere to go (the reference
+    # phones home; here a local JSONL sink). Without a sink the
+    # /internal/diagnostics route serves snapshots on demand instead.
+    diag_sink = metric_cfg.get("diagnostics-sink")
+    if diag_sink:
+        node.diagnostics.sink_path = os.path.expanduser(diag_sink)
+        node.diagnostics.start(float(metric_cfg.get("poll-interval", 60) or 60))
+    node.start()
+    print(f"pilosa-tpu server listening on http://{host}:{node.server.port}, data dir {data_dir}")
     try:
-        server.serve_forever()
+        import threading
+
+        threading.Event().wait()
     except KeyboardInterrupt:
         pass
     finally:
-        server.close()
+        node.stop()
     return 0
 
 

@@ -1,0 +1,181 @@
+"""System information (reference: gopsutil/ SystemInfo — uptime,
+platform, memory; server.go:793-835 monitorRuntime feeds it into stats).
+
+The reference shells out to gopsutil; here everything reads /proc
+directly (Linux-only, graceful zeros elsewhere) plus JAX device
+inventory — the TPU-native addition: accelerator kind/count belong in a
+TPU framework's system report.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import threading
+import time
+
+
+class SystemInfo:
+    """reference gopsutil/gopsutil.go systemInfo."""
+
+    _boot_time: float | None = None
+
+    def uptime(self) -> int:
+        """Seconds since host boot (reference Uptime)."""
+        try:
+            with open("/proc/uptime") as f:
+                return int(float(f.read().split()[0]))
+        except OSError:
+            return 0
+
+    def platform(self) -> str:
+        return platform.system().lower()
+
+    def family(self) -> str:
+        return platform.machine()
+
+    def os_version(self) -> str:
+        return platform.release()
+
+    def kernel_version(self) -> str:
+        return platform.version()
+
+    def _meminfo(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    val = rest.split()
+                    if val:
+                        out[key] = int(val[0]) * 1024  # kB -> bytes
+        except OSError:
+            pass
+        return out
+
+    def mem_total(self) -> int:
+        return self._meminfo().get("MemTotal", 0)
+
+    def mem_free(self) -> int:
+        m = self._meminfo()
+        return m.get("MemAvailable", m.get("MemFree", 0))
+
+    def mem_used(self) -> int:
+        m = self._meminfo()
+        total = m.get("MemTotal", 0)
+        return total - m.get("MemAvailable", m.get("MemFree", 0)) if total else 0
+
+    def cpu_count(self) -> int:
+        return os.cpu_count() or 0
+
+    def thread_count(self) -> int:
+        """Live Python threads — the goroutine-count analogue."""
+        return threading.active_count()
+
+    def process_rss(self) -> int:
+        """Resident set size of this process in bytes."""
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError):
+            return 0
+
+    def devices(self) -> list[dict]:
+        """Accelerator inventory (TPU-native extension)."""
+        try:
+            import jax
+
+            return [
+                {
+                    "id": d.id,
+                    "kind": d.device_kind,
+                    "platform": d.platform,
+                    "process": d.process_index,
+                }
+                for d in jax.devices()
+            ]
+        except Exception:
+            return []
+
+    def to_dict(self) -> dict:
+        return {
+            "uptime": self.uptime(),
+            "platform": self.platform(),
+            "family": self.family(),
+            "osVersion": self.os_version(),
+            "kernelVersion": self.kernel_version(),
+            "memTotal": self.mem_total(),
+            "memFree": self.mem_free(),
+            "memUsed": self.mem_used(),
+            "cpuCount": self.cpu_count(),
+            "threadCount": self.thread_count(),
+            "processRSS": self.process_rss(),
+            "devices": self.devices(),
+        }
+
+
+class GCNotifier:
+    """GC → stats bridge (reference gcnotify/ + server.go:826-833:
+    a channel that ticks after every garbage collection, counted into
+    the stats client). Uses CPython's gc callback hook.
+
+    The callback itself only bumps a bare int: CPython invokes
+    gc.callbacks synchronously on WHATEVER thread triggered collection,
+    possibly while that thread already holds the stats client's
+    non-reentrant lock (e.g. mid-snapshot) — calling into the client
+    here would self-deadlock. RuntimeMonitor publishes the counter as a
+    gauge instead."""
+
+    def __init__(self, stats_client=None):
+        import gc
+
+        self._gc = gc
+        self.stats = stats_client  # kept for API compat; not used in-callback
+        self.collections = 0
+        self._cb = self._on_gc
+        gc.callbacks.append(self._cb)
+
+    def _on_gc(self, phase: str, info: dict) -> None:
+        if phase == "stop":
+            self.collections += 1  # plain int bump: no locks, no allocation
+
+    def close(self) -> None:
+        try:
+            self._gc.callbacks.remove(self._cb)
+        except ValueError:
+            pass
+
+
+class RuntimeMonitor:
+    """Periodic runtime-metrics gauge loop (reference server.go:793-835
+    monitorRuntime: heap/goroutines/open-files into stats)."""
+
+    def __init__(self, stats_client, interval: float = 10.0, gc_notifier=None):
+        self.stats = stats_client
+        self.interval = interval
+        self.gc_notifier = gc_notifier
+        self.info = SystemInfo()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def poll_once(self) -> None:
+        self.stats.gauge("memory_rss_bytes", self.info.process_rss())
+        self.stats.gauge("threads", self.info.thread_count())
+        self.stats.gauge("host_mem_free_bytes", self.info.mem_free())
+        if self.gc_notifier is not None:
+            self.stats.gauge("garbage_collections", self.gc_notifier.collections)
+
+    def start(self) -> None:
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -88,6 +88,9 @@ class API:
             )
         self._lock = threading.RLock()
         self._state = STATE_NORMAL
+        # Diagnostics collector; NodeServer installs one (reference
+        # server.go diagnostics wiring).
+        self.diagnostics = None
 
     @property
     def state(self) -> str:

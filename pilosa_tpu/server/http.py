@@ -47,6 +47,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),
     ("GET", re.compile(r"^/export$"), "export"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import$"), "import_"),
@@ -181,6 +182,15 @@ class Handler(BaseHTTPRequestHandler):
         stats = self.api.holder.stats
         snap = stats.snapshot() if hasattr(stats, "snapshot") else {}
         self._send_json(200, snap)
+
+    def r_diagnostics(self):
+        """Diagnostics snapshot (reference diagnostics.go payload; local
+        endpoint replaces the reference's phone-home POST)."""
+        diag = getattr(self.api, "diagnostics", None)
+        if diag is None:
+            self._send_json(404, {"error": "diagnostics not enabled"})
+            return
+        self._send_json(200, diag.snapshot())
 
     def r_post_schema(self):
         self.api.apply_schema(self._json_body())

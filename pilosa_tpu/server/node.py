@@ -35,6 +35,7 @@ class NodeServer:
         n_words: int = SHARD_WORDS,
         long_query_time: float = 0.0,
         stats_client=None,
+        metric_poll_interval: float = 10.0,
     ):
         self.host = host
         self.holder = Holder(n_words)
@@ -73,6 +74,22 @@ class NodeServer:
             self.api.dist.local.translator = proxy
         self.server = Server(
             self.api, host=host, port=port, long_query_time=long_query_time
+        )
+        # Diagnostics + runtime metrics loops (reference server.go:433-436
+        # monitorDiagnostics/monitorRuntime, gcnotify).
+        from pilosa_tpu import __version__
+        from pilosa_tpu.obs.diagnostics import Diagnostics
+        from pilosa_tpu.obs.sysinfo import GCNotifier, RuntimeMonitor
+
+        self.diagnostics = Diagnostics(
+            self.holder, self.cluster, version=__version__
+        )
+        self.api.diagnostics = self.diagnostics
+        self.gc_notifier = GCNotifier()
+        self.runtime_monitor = RuntimeMonitor(
+            self.holder.stats,
+            interval=metric_poll_interval,
+            gc_notifier=self.gc_notifier,
         )
 
     # -- shard availability broadcasts (reference view.go:239-261
@@ -139,6 +156,7 @@ class NodeServer:
     def start(self) -> None:
         self.server.serve_background()
         self.cluster.local_node.uri = self.uri
+        self.runtime_monitor.start()
 
     @property
     def uri(self) -> str:
@@ -171,4 +189,7 @@ class NodeServer:
         self.cluster.set_static([Node(id=i, uri=u) for i, u in members])
 
     def stop(self) -> None:
+        self.runtime_monitor.stop()
+        self.diagnostics.stop()
+        self.gc_notifier.close()
         self.server.close()

@@ -1,0 +1,93 @@
+"""Diagnostics + system info tests (reference: diagnostics.go,
+gopsutil/, gcnotify/, server.go monitorRuntime/monitorDiagnostics)."""
+
+import gc
+import json
+import urllib.request
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.obs.diagnostics import Diagnostics
+from pilosa_tpu.obs.stats import MemStatsClient
+from pilosa_tpu.obs.sysinfo import GCNotifier, RuntimeMonitor, SystemInfo
+
+
+def test_sysinfo_fields():
+    info = SystemInfo().to_dict()
+    assert info["platform"] == "linux"
+    assert info["memTotal"] > 0
+    assert info["cpuCount"] >= 1
+    assert info["threadCount"] >= 1
+    assert info["processRSS"] > 0
+    assert info["uptime"] > 0
+    assert isinstance(info["devices"], list)
+
+
+def test_diagnostics_snapshot_counts_schema():
+    h = Holder()
+    idx = h.create_index("d", track_existence=False)
+    idx.create_field("f").set_bit(1, 5)
+    idx.create_field("g").set_bit(1, 6)
+    diag = Diagnostics(h, version="1.2.3")
+    diag.set("clusterID", "abc")
+    snap = diag.snapshot()
+    assert snap["version"] == "1.2.3"
+    assert snap["numIndexes"] == 1
+    assert snap["numFields"] == 2
+    assert snap["numFragments"] == 2
+    assert snap["numShards"] == 1
+    assert snap["clusterID"] == "abc"
+    assert snap["system"]["platform"] == "linux"
+
+
+def test_diagnostics_flush_sink(tmp_path):
+    h = Holder()
+    sink = str(tmp_path / "diag.jsonl")
+    diag = Diagnostics(h, version="x", sink_path=sink)
+    diag.flush()
+    diag.flush()
+    lines = open(sink).read().strip().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[0])["version"] == "x"
+
+
+def test_gc_notifier_counts_collections():
+    # The callback itself must stay lock-free (deadlock risk if it called
+    # into the stats client); the monitor publishes the gauge.
+    mem = MemStatsClient()
+    n = GCNotifier()
+    try:
+        gc.collect()
+        gc.collect()
+        assert n.collections >= 2
+        RuntimeMonitor(mem, gc_notifier=n).poll_once()
+        assert mem.snapshot()["gauges"]["garbage_collections"] >= 2
+    finally:
+        n.close()
+    before = n.collections
+    gc.collect()
+    assert n.collections == before  # detached after close
+
+
+def test_runtime_monitor_gauges():
+    mem = MemStatsClient()
+    RuntimeMonitor(mem).poll_once()
+    g = mem.snapshot()["gauges"]
+    assert g["memory_rss_bytes"] > 0
+    assert g["threads"] >= 1
+
+
+def test_http_diagnostics_route():
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(port=0)
+    node.start()
+    try:
+        node.api.create_index("i")
+        snap = json.loads(
+            urllib.request.urlopen(node.uri + "/internal/diagnostics").read()
+        )
+        assert snap["numIndexes"] == 1
+        assert snap["numNodes"] == 1
+        assert "system" in snap
+    finally:
+        node.stop()
