@@ -37,6 +37,9 @@ def _on_accelerator() -> bool:
     return jax.devices()[0].platform not in ("cpu",)
 
 
+from pilosa_tpu.ops import kernels
+
+
 @partial(jax.jit, static_argnames=())
 def _count_pair(bits, ra, rb):
     a = bits[:, ra]
@@ -44,26 +47,15 @@ def _count_pair(bits, ra, rb):
     return jnp.sum(lax.population_count(a & b).astype(jnp.int32), axis=-1)
 
 
-@jax.jit
 def _count_pairs_batched(bits, ras, rbs):
-    """One launch, B query pairs -> int32[B] totals. A device-side scan —
-    not vmap, which would materialize the [B, S, W] gather (21 GiB at full
-    size); each step streams just the two query rows from HBM."""
-
-    def body(_, q):
-        ra, rb = q
-        a = bits[:, ra]
-        b = bits[:, rb]
-        return None, jnp.sum(lax.population_count(a & b).astype(jnp.int32))
-
-    _, counts = lax.scan(body, None, (ras, rbs))
-    return counts
+    """One launch, B query pairs -> int32[B] totals: the framework's
+    serving-mode kernel (Pallas streaming gather+popcount, XLA scan
+    fallback — pilosa_tpu/ops/kernels.py)."""
+    return kernels.pair_count_batched(bits, ras, rbs)
 
 
-@jax.jit
 def _topn_counts(bits):
-    counts = jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=(0, 2))
-    return lax.top_k(counts, 10)
+    return kernels.topn_counts(bits, 10)
 
 
 def main() -> None:

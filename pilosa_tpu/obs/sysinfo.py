@@ -99,15 +99,18 @@ class SystemInfo:
             return []
 
     def to_dict(self) -> dict:
+        m = self._meminfo()
+        total = m.get("MemTotal", 0)
+        free = m.get("MemAvailable", m.get("MemFree", 0))
         return {
             "uptime": self.uptime(),
             "platform": self.platform(),
             "family": self.family(),
             "osVersion": self.os_version(),
             "kernelVersion": self.kernel_version(),
-            "memTotal": self.mem_total(),
-            "memFree": self.mem_free(),
-            "memUsed": self.mem_used(),
+            "memTotal": total,
+            "memFree": free,
+            "memUsed": total - free if total else 0,
             "cpuCount": self.cpu_count(),
             "threadCount": self.thread_count(),
             "processRSS": self.process_rss(),
@@ -125,20 +128,35 @@ class GCNotifier:
     possibly while that thread already holds the stats client's
     non-reentrant lock (e.g. mid-snapshot) — calling into the client
     here would self-deadlock. RuntimeMonitor publishes the counter as a
-    gauge instead."""
+    gauge instead.
 
-    def __init__(self, stats_client=None):
+    gc.callbacks is process-global, so the registered hook holds only a
+    weakref: a notifier dropped without close() unregisters itself on the
+    next collection instead of pinning its owner for the process
+    lifetime."""
+
+    def __init__(self):
         import gc
+        import weakref
 
         self._gc = gc
-        self.stats = stats_client  # kept for API compat; not used in-callback
         self.collections = 0
-        self._cb = self._on_gc
-        gc.callbacks.append(self._cb)
 
-    def _on_gc(self, phase: str, info: dict) -> None:
-        if phase == "stop":
-            self.collections += 1  # plain int bump: no locks, no allocation
+        ref = weakref.ref(self)
+
+        def _cb(phase: str, info: dict, _ref=ref, _gc=gc) -> None:
+            self_ = _ref()
+            if self_ is None:
+                try:
+                    _gc.callbacks.remove(_cb)
+                except ValueError:
+                    pass
+                return
+            if phase == "stop":
+                self_.collections += 1  # plain int bump: no locks, no allocation
+
+        self._cb = _cb
+        gc.callbacks.append(_cb)
 
     def close(self) -> None:
         try:

@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.core.field import Field
 from pilosa_tpu.core.view import VIEW_STANDARD
-from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops import bitops, kernels
 
 _OPS = {
     "intersect": lambda a, b: a & b,
@@ -55,20 +55,13 @@ def pair_op_count(bits, ra: jax.Array, rb: jax.Array, *, op: str) -> jax.Array:
     )
 
 
-@jax.jit
-def row_counts_all(bits) -> jax.Array:
-    """Popcount of every row summed over shards -> int32[n_rows].
+def pair_counts_batched(bits, ras, rbs, *, op: str = "intersect") -> jax.Array:
+    """Batch of Count(op(Row, Row)) totals -> int32[B], one launch.
 
-    The all-shards reduce rides ICI (XLA partitions the sum over the
-    ``shards`` axis then all-reduces)."""
-    return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=(0, 2))
-
-
-@partial(jax.jit, static_argnames=("n",))
-def topn_counts(bits, *, n: int):
-    """(top-n counts, row slots) by per-row popcount."""
-    counts = row_counts_all(bits)
-    return lax.top_k(counts, n)
+    Dispatches to the Pallas streaming kernel (ops/kernels.py) with an XLA
+    scan fallback — the serving-mode replacement for the reference's
+    per-query mapReduce (executor.go:2454-2518)."""
+    return kernels.pair_count_batched(bits, ras, rbs, op=op)
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -177,9 +170,18 @@ class ShardedField:
         )
         return int(np.asarray(per_shard).astype(np.int64).sum())
 
+    def count_pairs(
+        self, pairs: list[tuple[int, int]], op: str = "intersect"
+    ) -> list[int]:
+        """Answer a batch of Count(op(Row(a), Row(b))) in one device launch."""
+        ras = jnp.asarray([self.slot(a) for a, _ in pairs], jnp.int32)
+        rbs = jnp.asarray([self.slot(b) for _, b in pairs], jnp.int32)
+        out = pair_counts_batched(self.bits, ras, rbs, op=op)
+        return [int(c) for c in np.asarray(out).astype(np.int64)]
+
     def topn(self, n: int) -> list[tuple[int, int]]:
         n = min(n, len(self.row_ids)) or 1
-        counts, slots = topn_counts(self.bits, n=n)
+        counts, slots = kernels.topn_counts(self.bits, n)
         counts = np.asarray(counts)
         slots = np.asarray(slots)
         out = []
