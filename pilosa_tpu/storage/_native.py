@@ -1,0 +1,152 @@
+"""ctypes bindings for the native C++ roaring codec (native/roaring_codec.cpp).
+
+The reference's storage hot loops are compiled Go; here they are C++
+behind a C ABI.  The shared library is built on demand with g++ (cached
+next to the source), and every entry point degrades to ``None`` so
+callers fall back to the vectorized-numpy codec when no toolchain exists.
+Set ``PILOSA_TPU_NO_NATIVE=1`` to force the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "roaring_codec.cpp",
+)
+_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        _SRC,
+        "-o",
+        _LIB_PATH + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.rt_serialize.restype = ctypes.c_int
+        lib.rt_serialize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+            ctypes.c_uint8,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.rt_deserialize.restype = ctypes.c_int
+        lib.rt_deserialize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_popcount.restype = ctypes.c_uint64
+        lib.rt_popcount.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.rt_free.restype = None
+        lib.rt_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def serialize(positions: np.ndarray, flags: int = 0) -> bytes | None:
+    lib = load()
+    if lib is None:
+        return None
+    positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    rc = lib.rt_serialize(
+        positions.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        positions.size,
+        flags,
+        ctypes.byref(out),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.rt_free(out)
+
+
+def deserialize(data: bytes) -> tuple[np.ndarray, int] | None:
+    """(sorted positions, op count) or None on parse failure/unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    out = ctypes.POINTER(ctypes.c_uint64)()
+    out_n = ctypes.c_size_t()
+    ops = ctypes.c_uint64()
+    rc = lib.rt_deserialize(
+        buf, len(data), ctypes.byref(out), ctypes.byref(out_n), ctypes.byref(ops)
+    )
+    if rc != 0:
+        return None
+    try:
+        positions = np.ctypeslib.as_array(out, shape=(out_n.value,)).copy()
+    finally:
+        lib.rt_free(out)
+    return positions.astype(np.uint64), int(ops.value)
+
+
+def popcount(data: bytes | np.ndarray) -> int | None:
+    lib = load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(
+        np.frombuffer(data, dtype=np.uint8) if isinstance(data, bytes) else data.view(np.uint8)
+    )
+    return int(
+        lib.rt_popcount(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.size
+        )
+    )

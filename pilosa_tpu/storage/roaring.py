@@ -29,6 +29,8 @@ import struct
 
 import numpy as np
 
+from pilosa_tpu.storage import _native
+
 MAGIC = 12348
 COOKIE_NO_RUN = 12346  # official spec
 COOKIE_RUN = 12347  # official spec w/ run containers
@@ -70,7 +72,17 @@ class RoaringError(Exception):
 
 
 def serialize(positions: np.ndarray, flags: int = 0) -> bytes:
-    """Sorted uint64 bit positions -> Pilosa roaring file bytes."""
+    """Sorted uint64 bit positions -> Pilosa roaring file bytes.
+
+    Prefers the native C++ codec (native/roaring_codec.cpp, byte-identical
+    output); ``_serialize_py`` is the no-toolchain numpy fallback."""
+    native = _native.serialize(positions, flags)
+    if native is not None:
+        return native
+    return _serialize_py(positions, flags)
+
+
+def _serialize_py(positions: np.ndarray, flags: int = 0) -> bytes:
     positions = np.asarray(positions, dtype=np.uint64)
     if positions.size and np.any(positions[1:] <= positions[:-1]):
         positions = np.unique(positions)
@@ -172,6 +184,13 @@ def deserialize_with_opcount(data: bytes) -> tuple[np.ndarray, int]:
     while replaying on open)."""
     if len(data) < 8:
         raise RoaringError("file too short")
+    native = _native.deserialize(data)
+    if native is not None:
+        return native
+    return _deserialize_py(data)
+
+
+def _deserialize_py(data: bytes) -> tuple[np.ndarray, int]:
     (cookie,) = struct.unpack_from("<I", data, 0)
     magic = cookie & 0xFFFF
     if magic == MAGIC:

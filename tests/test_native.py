@@ -1,0 +1,132 @@
+"""Native C++ codec vs numpy codec equivalence.
+
+The C++ library (native/roaring_codec.cpp) must be byte-identical on
+serialize and position-identical on deserialize for every container
+encoding and op-log record type — the same matrix the reference covers in
+roaring/roaring_internal_test.go.  Skips when no g++ toolchain exists.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage import _native, roaring
+
+pytestmark = pytest.mark.skipif(
+    _native.load() is None, reason="native toolchain unavailable"
+)
+
+
+CASES = {
+    "empty": np.array([], dtype=np.uint64),
+    "array": np.array([1, 5, 9, 70000, 2**40], dtype=np.uint64),
+    "run": np.arange(10_000, 18_000, dtype=np.uint64),
+    "bitmap": np.arange(0, 65536, 2, dtype=np.uint64),
+    "mixed": np.concatenate(
+        [
+            np.arange(100, 5000, dtype=np.uint64),  # run
+            np.arange(65536, 65536 + 30000, 3, dtype=np.uint64),  # bitmap
+            np.array([2**33, 2**33 + 7], dtype=np.uint64),  # array
+        ]
+    ),
+    "unsorted_dups": np.array([9, 1, 9, 5, 1, 2**21], dtype=np.uint64),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_serialize_bytes_identical(name):
+    positions = CASES[name]
+    assert _native.serialize(positions) == roaring._serialize_py(positions)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_roundtrip_native(name):
+    positions = np.unique(CASES[name])
+    data = _native.serialize(CASES[name])
+    out, ops = _native.deserialize(data)
+    assert ops == 0
+    assert out.tolist() == positions.tolist()
+
+
+def test_deserialize_matches_python_with_oplog():
+    base = np.array([3, 10, 70000], dtype=np.uint64)
+    data = roaring._serialize_py(base)
+    data += roaring.encode_op(roaring.OP_ADD, 42)
+    data += roaring.encode_op(roaring.OP_REMOVE, 10)
+    data += roaring.encode_op(roaring.OP_ADD_BATCH, [100, 200, 2**30])
+    data += roaring.encode_op(roaring.OP_REMOVE_BATCH, [3, 999])
+    sub = roaring._serialize_py(np.array([7, 8, 9], dtype=np.uint64))
+    data += roaring.encode_op(roaring.OP_ADD_ROARING, roaring=sub, op_n=3)
+    sub2 = roaring._serialize_py(np.array([8, 200], dtype=np.uint64))
+    data += roaring.encode_op(roaring.OP_REMOVE_ROARING, roaring=sub2, op_n=2)
+
+    got, got_ops = _native.deserialize(data)
+    want, want_ops = roaring._deserialize_py(data)
+    assert got.tolist() == want.tolist()
+    assert got_ops == want_ops
+    assert got.tolist() == [7, 9, 42, 100, 70000, 2**30]
+
+
+def test_corrupt_oplog_truncates_same_as_python():
+    base = np.array([1, 2, 3], dtype=np.uint64)
+    data = roaring._serialize_py(base)
+    data += roaring.encode_op(roaring.OP_ADD, 50)
+    good_len = len(data)
+    data += b"\x00garbage-that-fails-checksum"
+    got, _ = _native.deserialize(data)
+    want, _ = roaring._deserialize_py(data)
+    assert got.tolist() == want.tolist() == [1, 2, 3, 50]
+    # sanity: the garbage really was past a valid record boundary
+    assert len(data) > good_len
+
+
+def test_official_format_parse():
+    # Build an official-spec file via the existing python test helper path:
+    # reuse roaring's serializer for positions in pilosa format, then
+    # hand-craft a small official no-run file.
+    import struct
+
+    vals = [1, 3, 4, 5, 100]
+    out = struct.pack("<II", roaring.COOKIE_NO_RUN, 1)
+    out += struct.pack("<HH", 0, len(vals) - 1)
+    out += struct.pack("<I", len(out) + 4)
+    out += np.array(vals, dtype="<u2").tobytes()
+    got, ops = _native.deserialize(out)
+    want, _ = roaring._deserialize_py(out)
+    assert got.tolist() == want.tolist() == vals
+    assert ops == 0
+
+
+def test_native_popcount():
+    arr = np.array([0xFFFFFFFF, 0, 0b1011], dtype=np.uint32)
+    assert _native.popcount(arr) == 32 + 0 + 3
+    assert _native.popcount(arr.tobytes()) == 35
+
+
+def test_fuzz_roundtrip_random():
+    rng = np.random.default_rng(99)
+    for _ in range(25):
+        n = int(rng.integers(0, 5000))
+        positions = rng.integers(0, 2**48, size=n, dtype=np.uint64)
+        nat = _native.serialize(positions)
+        py = roaring._serialize_py(positions)
+        assert nat == py
+        got, _ = _native.deserialize(nat)
+        assert got.tolist() == np.unique(positions).tolist()
+
+
+def test_fuzz_corrupt_inputs_dont_crash():
+    """Reference fuzzes bitmap unmarshal (roaring/fuzzer.go); the native
+    reader must reject or truncate garbage without crashing the process."""
+    rng = np.random.default_rng(7)
+    base = roaring._serialize_py(np.arange(0, 3000, 2, dtype=np.uint64))
+    for _ in range(50):
+        buf = bytearray(base)
+        for _ in range(int(rng.integers(1, 8))):
+            buf[int(rng.integers(0, len(buf)))] = int(rng.integers(0, 256))
+        try:
+            res = _native.deserialize(bytes(buf))
+        except Exception as e:  # must never segfault; python-level errors ok
+            pytest.fail(f"native deserialize raised {e!r}")
+        if res is not None:
+            positions, _ = res
+            assert positions.dtype == np.uint64
