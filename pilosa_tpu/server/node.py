@@ -91,6 +91,7 @@ class NodeServer:
             interval=metric_poll_interval,
             gc_notifier=self.gc_notifier,
         )
+        self.membership = None  # started on demand via start_membership()
 
     # -- shard availability broadcasts (reference view.go:239-261
     #    CreateShardMessage) ------------------------------------------------
@@ -188,7 +189,29 @@ class NodeServer:
         self.cluster.disabled = False
         self.cluster.set_static([Node(id=i, uri=u) for i, u in members])
 
+    def start_membership(
+        self, probe_interval: float = 1.0, confirm_retries: int = 10,
+        confirm_interval: float = 0.1,
+    ) -> "MembershipMonitor":
+        """Begin heartbeat failure detection over the current membership
+        (reference gossip probes + confirmNodeDown, cluster.go:1699-1768)."""
+        from pilosa_tpu.cluster.membership import MembershipMonitor
+
+        if self.membership is None:
+            self.membership = MembershipMonitor(
+                self.cluster,
+                self.client,
+                broadcaster=self.broadcaster,
+                probe_interval=probe_interval,
+                confirm_retries=confirm_retries,
+                confirm_interval=confirm_interval,
+            )
+            self.membership.start()
+        return self.membership
+
     def stop(self) -> None:
+        if self.membership is not None:
+            self.membership.stop()
         self.runtime_monitor.stop()
         self.diagnostics.stop()
         self.gc_notifier.close()

@@ -1,0 +1,185 @@
+"""Membership monitor: probing, confirm-down, state machine reactions
+(reference cluster.go:1699-1768 confirmNodeDown/ReceiveEvent and
+gossip probe behavior; multi-node path mirrors server/cluster_test.go)."""
+
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.cluster import (
+    Cluster,
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_STARTING,
+)
+from pilosa_tpu.cluster.membership import MembershipMonitor
+from pilosa_tpu.cluster.topology import (
+    NODE_STATE_DOWN,
+    NODE_STATE_READY,
+    Node,
+)
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+
+class StubClient:
+    """Liveness controlled per-uri; counts version probes."""
+
+    def __init__(self):
+        self.alive: dict[str, bool] = {}
+        self.probes: dict[str, int] = {}
+
+    def version(self, uri):
+        self.probes[uri] = self.probes.get(uri, 0) + 1
+        if not self.alive.get(uri, True):
+            raise ConnectionError("down")
+        return {"version": "test"}
+
+
+class StubBroadcaster:
+    def __init__(self):
+        self.sent = []
+
+    def send_sync(self, msg):
+        self.sent.append(msg)
+
+
+def _cluster(replica_n=2):
+    c = Cluster("a", replica_n=replica_n, disabled=False)
+    c.coordinator_id = "a"
+    c.set_static(
+        [
+            Node(id="a", uri="http://a"),
+            Node(id="b", uri="http://b"),
+            Node(id="c", uri="http://c"),
+        ]
+    )
+    return c
+
+
+def test_confirm_down_requires_all_retries_failing():
+    c = _cluster()
+    client = StubClient()
+    mon = MembershipMonitor(
+        c, client, confirm_retries=5, confirm_interval=0.001
+    )
+    client.alive["http://b"] = False
+    assert mon.confirm_node_down(c.node("b")) is True
+    assert client.probes["http://b"] == 5
+
+    # A node that answers mid-confirmation is not declared down
+    # (reference suppresses false leaves the same way).
+    client.probes.clear()
+    calls = {"n": 0}
+
+    class FlakyClient(StubClient):
+        def version(self, uri):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("slow start")
+            return {}
+
+    mon2 = MembershipMonitor(
+        c, FlakyClient(), confirm_retries=10, confirm_interval=0.001
+    )
+    assert mon2.confirm_node_down(c.node("b")) is False
+
+
+def test_probe_transitions_and_degraded_state():
+    c = _cluster(replica_n=2)
+    client = StubClient()
+    bcast = StubBroadcaster()
+    events = []
+    mon = MembershipMonitor(
+        c,
+        client,
+        broadcaster=bcast,
+        confirm_retries=2,
+        confirm_interval=0.001,
+        on_change=lambda nid, st: events.append((nid, st)),
+    )
+    client.alive["http://b"] = False
+    assert mon.probe_node(c.node("b")) is False
+    assert c.node("b").state == NODE_STATE_DOWN
+    # one node down < replica_n=2 -> DEGRADED (determineClusterState)
+    assert c.state == STATE_DEGRADED
+    assert events == [("b", NODE_STATE_DOWN)]
+    assert bcast.sent[-1]["type"] == "node-state"
+    assert bcast.sent[-1]["state"] == NODE_STATE_DOWN
+
+    # recovery: one successful probe flips it back and state normalizes
+    client.alive["http://b"] = True
+    assert mon.probe_node(c.node("b")) is True
+    assert c.node("b").state == NODE_STATE_READY
+    assert c.state == STATE_NORMAL
+    assert events[-1] == ("b", NODE_STATE_READY)
+
+
+def test_losing_replican_nodes_drops_to_starting():
+    c = _cluster(replica_n=1)
+    client = StubClient()
+    mon = MembershipMonitor(c, client, confirm_retries=1, confirm_interval=0.001)
+    client.alive["http://b"] = False
+    mon.probe_node(c.node("b"))
+    # down >= replica_n=1: data unavailable
+    assert c.state == STATE_STARTING
+
+
+def test_non_coordinator_does_not_broadcast():
+    c = _cluster()
+    c.coordinator_id = "b"
+    for n in c.nodes:
+        n.is_coordinator = n.id == "b"
+    client = StubClient()
+    bcast = StubBroadcaster()
+    mon = MembershipMonitor(
+        c, client, broadcaster=bcast, confirm_retries=1, confirm_interval=0.001
+    )
+    client.alive["http://c"] = False
+    mon.probe_node(c.node("c"))
+    assert c.node("c").state == NODE_STATE_DOWN
+    assert bcast.sent == []
+
+
+def test_probe_once_round_robins_peers():
+    c = _cluster()
+    client = StubClient()
+    mon = MembershipMonitor(c, client)
+    for _ in range(4):
+        mon.probe_once()
+    assert set(client.probes) == {"http://b", "http://c"}
+
+
+def test_background_thread_detects_real_node_failure():
+    """In-process integration: kill a node, watch the coordinator's
+    monitor converge the cluster to DEGRADED and broadcast to peers."""
+    with InProcessCluster(3, replica_n=2) as cluster:
+        coord = cluster.coordinator
+        mon = coord.start_membership(
+            probe_interval=0.05, confirm_retries=2, confirm_interval=0.01
+        )
+        assert mon is coord.start_membership()  # idempotent
+        victim = next(n for n in cluster.nodes if n is not coord)
+        victim_id = victim.node_id
+        victim.stop()
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if coord.cluster.state == STATE_DEGRADED:
+                break
+            time.sleep(0.05)
+        assert coord.cluster.state == STATE_DEGRADED
+        assert coord.cluster.node(victim_id).state == NODE_STATE_DOWN
+
+        # the surviving follower learned about it via broadcast
+        survivor = next(
+            n
+            for n in cluster.nodes
+            if n is not coord and n.node_id != victim_id
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if survivor.cluster.node(victim_id).state == NODE_STATE_DOWN:
+                break
+            time.sleep(0.05)
+        assert survivor.cluster.node(victim_id).state == NODE_STATE_DOWN
+        assert survivor.cluster.state == STATE_DEGRADED
