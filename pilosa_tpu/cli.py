@@ -229,6 +229,13 @@ def cmd_generate_config(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """Print the effective configuration after file + env merging
+    (reference `pilosa config`, ctl/config.go)."""
+    print(json.dumps(_load_config(args.config), indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="pilosa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -268,6 +275,12 @@ def main(argv=None) -> int:
 
     pg = sub.add_parser("generate-config", help="print default config")
     pg.set_defaults(fn=cmd_generate_config)
+
+    pcfg = sub.add_parser(
+        "config", help="print the effective config (file + env merged)"
+    )
+    pcfg.add_argument("-c", "--config", default=None)
+    pcfg.set_defaults(fn=cmd_config)
 
     args = p.parse_args(argv)
     return args.fn(args)
