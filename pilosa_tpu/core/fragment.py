@@ -84,6 +84,9 @@ class Fragment:
         self._device: jax.Array | None = None
         self._dirty: set[int] = set()
         self._counts: np.ndarray | None = None  # per-slot cached popcounts
+        # Monotonic mutation counter: cheap cache key for stacked-tensor
+        # caches built over this fragment (executor batch fast path).
+        self.version = 0
         # op accounting for the storage layer's snapshot trigger
         # (reference fragment.go:84 MaxOpN, 2284-2293).
         self.op_n = 0
@@ -135,6 +138,7 @@ class Fragment:
     def _touch(self, slot: int) -> None:
         self._dirty.add(slot)
         self._counts = None
+        self.version += 1
         self.op_n += 1
         if self.on_op is not None:
             self.on_op(self)
@@ -485,6 +489,7 @@ class Fragment:
             self._device = None
             self._dirty.clear()
             self._counts = None
+            self.version += 1
             for row in sorted(rows):
                 s = self._slot(row, create=True)
                 self._host[s] = np.asarray(rows[row], dtype=np.uint32)

@@ -52,6 +52,30 @@ from pilosa_tpu.pql.ast import Call, Condition
 # reference executor.go:66 defaultMinThreshold.
 DEFAULT_MIN_THRESHOLD = 1
 
+# Sentinel for "not yet computed" result slots in the batch fast path.
+_UNSET = object()
+
+# Largest stacked [S, R, W] tensor the batch fast path will materialize.
+_STACK_BUDGET_BYTES = 4 << 30  # device serving stacks; tuned for v5e HBM
+
+_PAIR_OPS = {
+    "Intersect": "intersect",
+    "Union": "union",
+    "Difference": "difference",
+    "Xor": "xor",
+}
+
+# Calls that mutate state; the batch fast path must not answer reads that
+# appear after one of these in the same query (in-order semantics).
+_WRITE_CALLS = {
+    "Set",
+    "Clear",
+    "ClearRow",
+    "Store",
+    "SetRowAttrs",
+    "SetColumnAttrs",
+}
+
 
 class ExecuteError(Exception):
     pass
@@ -86,15 +110,165 @@ class Executor:
         q = pql.parse(query) if isinstance(query, str) else query
         # span per query (reference executor.go:117 "Executor.Execute")
         with tracing.start_span("executor.Execute").set_tag("index", index_name):
-            results = []
-            for call in q.calls:
-                call = call.clone()
+            calls = [c.clone() for c in q.calls]
+            for call in calls:
                 self._translate_call(idx, call)
-                with tracing.start_span(f"executor.execute{call.name}"):
-                    results.append(self._execute_call(idx, call, shards))
+            results: list[Any] = [_UNSET] * len(calls)
+            # Serving-mode fast path: many Count(op(Row,Row)) calls in one
+            # query collapse into a single batched device launch.
+            self._batch_pair_counts(idx, calls, shards, results)
+            for i, call in enumerate(calls):
+                if results[i] is _UNSET:
+                    with tracing.start_span(f"executor.execute{call.name}"):
+                        results[i] = self._execute_call(idx, call, shards)
             return [
                 self._translate_result(idx, c, r) for c, r in zip(q.calls, results)
             ]
+
+    # ----------------------------------------------- batched Count fast path
+
+    def _match_pair_count(self, idx: Index, call: Call):
+        """(field_name, op, row_a, row_b) when ``call`` is a batchable
+        ``Count(op(Row(f=a), Row(f=b)))`` over one set-like field; None
+        otherwise."""
+        if call.name != "Count" or len(call.children) != 1 or call.args:
+            return None
+        child = call.children[0]
+        op = _PAIR_OPS.get(child.name)
+        if op is None or len(child.children) != 2 or child.args:
+            return None
+        fname = None
+        rows: list[int] = []
+        for rc in child.children:
+            if rc.name != "Row" or rc.children:
+                return None
+            f = rc.field_arg()
+            if f is None or set(rc.args) != {f}:
+                return None
+            v = rc.args.get(f)
+            if not isinstance(v, int) or isinstance(v, bool):
+                return None
+            if fname is None:
+                fname = f
+            elif fname != f:
+                return None
+            rows.append(v)
+        field = idx.field(fname)
+        if field is None or field.field_type == FIELD_TYPE_INT:
+            return None
+        if field.view(VIEW_STANDARD) is None:
+            return None
+        return fname, op, rows[0], rows[1]
+
+    def _field_stack(self, field: Field, shards: list[int]):
+        """(slot_of, bits[S, R, W] device tensor) for the field's standard
+        view over ``shards``, cached on the field and invalidated by any
+        fragment mutation (version counters). None when over budget or
+        empty."""
+        v = field.view(VIEW_STANDARD)
+        frags = [(s, v.fragments[s]) for s in shards if s in v.fragments]
+        if not frags:
+            return None
+        key = (
+            tuple(s for s, _ in frags),
+            tuple(f.version for _, f in frags),
+        )
+        cache = getattr(field, "_stack_cache", None)
+        if cache is not None and cache[0] == key:
+            return cache[1], cache[2]
+        row_ids = sorted({r for _, f in frags for r in f.row_ids()})
+        if not row_ids:
+            return None
+        S, R, W = len(frags), len(row_ids), field.n_words
+        if S * R * W * 4 > _STACK_BUDGET_BYTES:
+            return None
+        slot_of = {r: i for i, r in enumerate(row_ids)}
+        bits = np.zeros((S, R, W), dtype=np.uint32)
+        for si, (_, f) in enumerate(frags):
+            for r in f.row_ids():
+                bits[si, slot_of[r]] = f.row_words_host(r)
+        dev = jnp.asarray(bits)
+        field._stack_cache = (key, slot_of, dev)
+        return slot_of, dev
+
+    def _batch_pair_counts(
+        self, idx: Index, calls: list[Call], shards: list[int] | None,
+        results: list[Any],
+    ) -> None:
+        """Answer every batchable Count(op(Row,Row)) call in ``calls`` with
+        one device launch per (field, op) group — the serving-mode shape
+        where the reference would run one goroutine map-reduce per query
+        (executor.go:2454-2518). Launch batches pad to powers of two so
+        jit programs are reused across batch sizes.
+
+        Only calls BEFORE the first write call are eligible: they observe
+        exactly the pre-loop state they would see executing in order.
+        A field engages only when >= 2 of its Counts batch (the stack
+        build is full-field; version-keyed caching makes it pay off on
+        read-heavy serving workloads, while write-interleaved workloads
+        fall through to the per-call path)."""
+        from pilosa_tpu.ops import kernels
+
+        first_write = next(
+            (i for i, c in enumerate(calls) if c.name in _WRITE_CALLS),
+            len(calls),
+        )
+        by_field: dict[str, list[tuple[int, str, int, int]]] = {}
+        for i, call in enumerate(calls[:first_write]):
+            m = self._match_pair_count(idx, call)
+            if m is not None:
+                fname, op, ra, rb = m
+                by_field.setdefault(fname, []).append((i, op, ra, rb))
+        shard_list = None
+
+        def _count_stat() -> None:
+            self.holder.stats.count_with_tags(
+                "query_total", 1, 1.0, (f"index:{idx.name}", "call:Count")
+            )
+
+        for fname, items in by_field.items():
+            if len(items) < 2:
+                continue
+            field = idx.field(fname)
+            if shard_list is None:
+                shard_list = self._shards_for(idx, shards)
+            stack = self._field_stack(field, shard_list)
+            if stack is None:
+                continue
+            slot_of, bits = stack
+            by_op: dict[str, list[tuple[int, int, int]]] = {}
+            for i, op, ra, rb in items:
+                sa, sb = slot_of.get(ra), slot_of.get(rb)
+                if sa is None or sb is None:
+                    # Intersect with an absent row is provably 0; other
+                    # ops (union/difference/xor) need the present side's
+                    # count, so they take the normal path.
+                    if op == "intersect":
+                        results[i] = 0
+                        _count_stat()
+                    continue
+                by_op.setdefault(op, []).append((i, sa, sb))
+            for op, launch in by_op.items():
+                B = 1 << (len(launch) - 1).bit_length()
+                ras = np.zeros(B, dtype=np.int32)
+                rbs = np.zeros(B, dtype=np.int32)
+                for j, (_, sa, sb) in enumerate(launch):
+                    ras[j], rbs[j] = sa, sb
+                with tracing.start_span("executor.batchPairCount").set_tag(
+                    "field", fname
+                ).set_tag("n", len(launch)):
+                    # [B, S] per-shard partials; summed host-side in int64
+                    # so totals past 2^31 stay exact (same rule as
+                    # Row.count's per-segment sum).
+                    partials = np.asarray(
+                        kernels.pair_count_batched(
+                            bits, jnp.asarray(ras), jnp.asarray(rbs), op=op
+                        )
+                    ).astype(np.int64)
+                    counts = partials.sum(axis=1)
+                    for j, (i, _, _) in enumerate(launch):
+                        results[i] = int(counts[j])
+                        _count_stat()
 
     # ------------------------------------------------------- key translation
 

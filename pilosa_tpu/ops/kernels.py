@@ -77,16 +77,15 @@ def _word_block(w: int) -> int:
 
 def _pair_count_kernel(op, ras_ref, rbs_ref, a_ref, b_ref, out_ref):
     del ras_ref, rbs_ref  # consumed by the index maps
-    s = pl.program_id(1)
     w = pl.program_id(2)
     words = _OPS[op](a_ref[0, 0, :], b_ref[0, 0, :])
     block_total = jnp.sum(lax.population_count(words).astype(jnp.int32))
 
-    @pl.when(jnp.logical_and(s == 0, w == 0))
+    @pl.when(w == 0)
     def _():
         out_ref[0, 0] = block_total
 
-    @pl.when(jnp.logical_not(jnp.logical_and(s == 0, w == 0)))
+    @pl.when(w != 0)
     def _():
         out_ref[0, 0] = out_ref[0, 0] + block_total
 
@@ -95,19 +94,22 @@ def _pair_count_kernel(op, ras_ref, rbs_ref, a_ref, b_ref, out_ref):
 def pair_count_batched_pallas(
     bits: jax.Array, ras: jax.Array, rbs: jax.Array, *, op: str = "intersect"
 ) -> jax.Array:
-    """``int32[B]`` totals of ``popcount(op(bits[:, ras[i]], bits[:, rbs[i]]))``.
+    """``int32[B, S]`` per-shard counts of
+    ``popcount(op(bits[:, ras[i]], bits[:, rbs[i]]))``.
 
     One Pallas launch for the whole query batch; grid (B, S, W-blocks) with
     the two query rows scalar-prefetch-indexed so only 2*WB words stream
     into VMEM per step (reference executor.go:653-680 per-shard bitmap call
-    + roaring.go:568 count loop, batched the TPU way).
+    + roaring.go:568 count loop, batched the TPU way).  Per-shard partials
+    (a shard holds <= 2^20*rows bits, always int32-safe) are returned so
+    callers can sum in int64 host-side — cross-shard totals may pass 2^31.
     """
     S, R, W = bits.shape
     B = ras.shape[0]
     wb = _word_block(W)
     grid = (B, S, W // wb)
     kernel = partial(_pair_count_kernel, op)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -126,14 +128,13 @@ def pair_count_batched_pallas(
             ],
             out_specs=pl.BlockSpec(
                 (1, 1),
-                lambda b, s, w, ras_ref, rbs_ref: (b, 0),
+                lambda b, s, w, ras_ref, rbs_ref: (b, s),
                 memory_space=pltpu.SMEM,
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((B, S), jnp.int32),
         interpret=_interpret(),
     )(ras.astype(jnp.int32), rbs.astype(jnp.int32), bits, bits)
-    return out[:, 0]
 
 
 @partial(jax.jit, static_argnames=("op",))
@@ -141,12 +142,15 @@ def pair_count_batched_xla(
     bits: jax.Array, ras: jax.Array, rbs: jax.Array, *, op: str = "intersect"
 ) -> jax.Array:
     """Fallback: device-side scan over the query batch (not vmap, which
-    would materialize the [B, S, W] gather)."""
+    would materialize the [B, S, W] gather). Returns int32[B, S] per-shard
+    partials like the Pallas kernel."""
 
     def body(_, q):
         ra, rb = q
         words = _OPS[op](bits[:, ra], bits[:, rb])
-        return None, jnp.sum(lax.population_count(words).astype(jnp.int32))
+        return None, jnp.sum(
+            lax.population_count(words).astype(jnp.int32), axis=-1
+        )
 
     _, counts = lax.scan(body, None, (ras, rbs))
     return counts

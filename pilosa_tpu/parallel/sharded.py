@@ -56,7 +56,8 @@ def pair_op_count(bits, ra: jax.Array, rb: jax.Array, *, op: str) -> jax.Array:
 
 
 def pair_counts_batched(bits, ras, rbs, *, op: str = "intersect") -> jax.Array:
-    """Batch of Count(op(Row, Row)) totals -> int32[B], one launch.
+    """Batch of Count(op(Row, Row)) per-shard partials -> int32[B, S], one
+    launch (sum in int64 host-side; cross-shard totals may pass 2^31).
 
     Dispatches to the Pallas streaming kernel (ops/kernels.py) with an XLA
     scan fallback — the serving-mode replacement for the reference's
@@ -177,7 +178,7 @@ class ShardedField:
         ras = jnp.asarray([self.slot(a) for a, _ in pairs], jnp.int32)
         rbs = jnp.asarray([self.slot(b) for _, b in pairs], jnp.int32)
         out = pair_counts_batched(self.bits, ras, rbs, op=op)
-        return [int(c) for c in np.asarray(out).astype(np.int64)]
+        return [int(c) for c in np.asarray(out).astype(np.int64).sum(axis=1)]
 
     def topn(self, n: int) -> list[tuple[int, int]]:
         n = min(n, len(self.row_ids)) or 1

@@ -37,7 +37,7 @@ def test_pair_count_batched_matches_numpy(op):
         kernels.pair_count_batched_pallas(
             jnp.asarray(bits), jnp.asarray(ras), jnp.asarray(rbs), op=op
         )
-    )
+    ).astype(np.int64).sum(axis=1)
     want = np.array(
         [
             np.bitwise_count(OPS_NP[op](bits[:, ra], bits[:, rb])).sum()
@@ -69,7 +69,7 @@ def test_pair_count_word_blocking():
         kernels.pair_count_batched_pallas(
             jnp.asarray(bits), jnp.asarray(ras), jnp.asarray(rbs)
         )
-    )
+    ).astype(np.int64).sum(axis=1)
     want = [
         int(np.bitwise_count(bits[:, ra] & bits[:, rb]).sum())
         for ra, rb in zip(ras, rbs)
@@ -101,5 +101,5 @@ def test_dispatch_wrappers_run():
     bits = jnp.asarray(_rand_bits(rng, 2, 3, 128))
     ras = jnp.asarray([0, 2], jnp.int32)
     rbs = jnp.asarray([1, 1], jnp.int32)
-    assert kernels.pair_count_batched(bits, ras, rbs).shape == (2,)
+    assert kernels.pair_count_batched(bits, ras, rbs).shape == (2, 2)
     assert kernels.row_counts(bits).shape == (3,)
