@@ -42,7 +42,10 @@ class HolderSyncer:
     def sync_holder(self) -> dict:
         """One full anti-entropy pass. Returns counters for observability
         (reference SyncHolder holder.go:683)."""
-        stats = {"fragments": 0, "blocks_diff": 0, "bits_set": 0, "bits_cleared": 0}
+        stats = {
+            "fragments": 0, "blocks_diff": 0, "bits_set": 0,
+            "bits_cleared": 0, "attrs_merged": 0,
+        }
         if len(self.cluster.nodes) <= 1:
             return stats
         # span per pass (reference holder.go:683 SyncHolder spans)
@@ -52,10 +55,14 @@ class HolderSyncer:
                 idx = self.holder.index(index_name)
                 if idx is None:
                     continue
+                # column attrs (reference holder.go:747-790 syncIndex)
+                self.sync_attrs(index_name, None, idx.column_attrs, stats)
                 for fname in idx.field_names(include_internal=True):
                     field = idx.field(fname)
                     if field is None:
                         continue
+                    # row attrs (reference holder.go:793-839 syncField)
+                    self.sync_attrs(index_name, fname, field.row_attrs, stats)
                     for vname in field.view_names():
                         view = field.view(vname)
                         for shard in sorted(view.fragments):
@@ -97,6 +104,42 @@ class HolderSyncer:
             # available-shard bitmaps, gossip.go:321-357)
             if status.get("availableShards"):
                 self.api.merge_available_shards(status["availableShards"])
+
+    # -- attr sync (reference holder.go:747-839 syncIndex/syncField) --------
+
+    def sync_attrs(self, index: str, field: str | None, store, stats: dict) -> None:
+        """Pull-merge attribute blocks that differ from any peer. Attrs
+        replicate to every node at write time (broadcast writes); each
+        node's pass pulls blocks it is missing, so all converge without a
+        push path (the reference does the same via AttrStore diffs)."""
+        local = {bid: chk.hex() for bid, chk in store.blocks()}
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.node_id:
+                continue
+            try:
+                remote = {
+                    b["id"]: b["checksum"]
+                    for b in self.client.attr_blocks(node.uri, index, field)
+                }
+            except ClientError:
+                continue
+            for bid, chk in remote.items():
+                if local.get(bid) == chk:
+                    continue
+                try:
+                    attrs = self.client.attr_block_data(
+                        node.uri, index, field, bid
+                    )
+                except ClientError as e:
+                    logger.warning(
+                        "attr block fetch from %s failed: %s", node.id, e
+                    )
+                    continue
+                if attrs:
+                    store.set_bulk_attrs(attrs)
+                    stats["attrs_merged"] += len(attrs)
+            # refresh local checksums after merging this peer
+            local = {bid: chk.hex() for bid, chk in store.blocks()}
 
     # -- fragment sync (reference fragment.go:2849 syncFragment) ------------
 

@@ -126,6 +126,23 @@ class InternalClient:
              "shard": shard, "block": block},
         )
 
+    def attr_blocks(self, uri: str, index: str, field: str | None) -> list[dict]:
+        """Attr block checksums (reference http/client.go attr diff calls,
+        holder.go:747-839 syncIndex/syncField)."""
+        q = f"?index={index}" + (f"&field={field}" if field else "")
+        return self._json("GET", uri, f"/internal/attr/blocks{q}")["blocks"]
+
+    def attr_block_data(
+        self, uri: str, index: str, field: str | None, block: int
+    ) -> dict:
+        resp = self._json(
+            "POST",
+            uri,
+            "/internal/attr/block/data",
+            {"index": index, "field": field, "block": block},
+        )
+        return {int(k): v for k, v in resp["attrs"].items()}
+
     def retrieve_fragment(
         self, uri: str, index: str, field: str, view: str, shard: int
     ) -> bytes:
@@ -196,6 +213,12 @@ class NopInternalClient:
 
     def fragment_blocks(self, uri, index, field, view, shard):
         return []
+
+    def attr_blocks(self, uri, index, field):
+        return []
+
+    def attr_block_data(self, uri, index, field, block):
+        return {}
 
     def block_data(self, uri, index, field, view, shard, block):
         return {"rows": [], "cols": []}

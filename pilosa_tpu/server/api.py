@@ -606,6 +606,39 @@ class API:
         rows, cols = frag.block_data(int(req["block"]))
         return {"rows": rows, "cols": cols}
 
+    def _attr_store(self, index: str, field: str | None):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ApiError(f"index not found: {index}", 404)
+        if not field:
+            return idx.column_attrs
+        f = idx.field(field)
+        if f is None:
+            raise ApiError(f"field not found: {field}", 404)
+        return f.row_attrs
+
+    def attr_blocks(self, index: str, field: str | None) -> dict:
+        """Attr block checksums for anti-entropy diff (reference
+        api.go:590-660 fragment/attr block endpoints; attr.go:81-120)."""
+        self._validate("FragmentBlocks")
+        store = self._attr_store(index, field)
+        return {
+            "blocks": [
+                {"id": bid, "checksum": chk.hex()}
+                for bid, chk in store.blocks()
+            ]
+        }
+
+    def attr_block_data(self, req: dict) -> dict:
+        self._validate("FragmentBlockData")
+        store = self._attr_store(req["index"], req.get("field"))
+        return {
+            "attrs": {
+                str(k): v
+                for k, v in store.block_data(int(req["block"])).items()
+            }
+        }
+
     def fragment_data(self, index: str, field: str, view: str, shard: int) -> bytes:
         """Whole-fragment snapshot as a roaring blob (reference
         api.go FragmentData; fragment.go:2424-2594 tar WriteTo)."""

@@ -62,6 +62,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
     ("POST", re.compile(r"^/internal/translate/ids$"), "translate_ids"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "cluster_message"),
+    ("GET", re.compile(r"^/internal/attr/blocks$"), "attr_blocks"),
+    ("POST", re.compile(r"^/internal/attr/block/data$"), "attr_block_data"),
     ("GET", re.compile(r"^/internal/fragment/blocks$"), "fragment_blocks"),
     ("POST", re.compile(r"^/internal/fragment/block/data$"), "fragment_block_data"),
     ("GET", re.compile(r"^/internal/fragment/data$"), "fragment_data"),
@@ -270,6 +272,15 @@ class Handler(BaseHTTPRequestHandler):
 
     def r_nodes(self):
         self._send_json(200, self.api.hosts())
+
+    def r_attr_blocks(self):
+        p = {k: v[0] for k, v in self.query_params.items()}
+        self._send_json(
+            200, self.api.attr_blocks(p["index"], p.get("field") or None)
+        )
+
+    def r_attr_block_data(self):
+        self._send_json(200, self.api.attr_block_data(self._json_body()))
 
     def r_fragment_blocks(self):
         p = {k: v[0] for k, v in self.query_params.items()}

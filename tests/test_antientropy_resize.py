@@ -226,3 +226,27 @@ def test_antientropy_survives_unencodable_row_ids():
         b = c.nodes[1].holder.fragment("big", "f", "standard", shard0)
         assert b.get_bit(9, 123)
         assert stats["bits_set"] >= 1
+
+
+def test_attr_anti_entropy_converges():
+    """Attr blocks missing on a replica heal via pull-merge (reference
+    holder.go:747-839 syncIndex/syncField attr diffs)."""
+    from pilosa_tpu.testing.cluster import InProcessCluster
+
+    with InProcessCluster(3, replica_n=2) as cluster:
+        cluster.create_index("ai")
+        cluster.create_field("ai", "af")
+        # plant attrs directly in ONE node's local stores, skipping the
+        # broadcast write path (simulates a missed broadcast)
+        n0 = cluster.nodes[0]
+        n0.holder.index("ai").field("af").row_attrs.set_attrs(
+            7, {"name": "seven", "rank": 1}
+        )
+        n0.holder.index("ai").column_attrs.set_attrs(123, {"tag": "x"})
+        cluster.sync_all()
+        for n in cluster.nodes:
+            assert n.holder.index("ai").field("af").row_attrs.attrs(7) == {
+                "name": "seven",
+                "rank": 1,
+            }, n.node_id
+            assert n.holder.index("ai").column_attrs.attrs(123) == {"tag": "x"}
