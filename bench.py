@@ -58,6 +58,39 @@ def _topn_counts(bits):
     return kernels.topn_counts(bits, 10)
 
 
+def _bsi_range_fn(depth, value):
+    """Jitted all-shards BSI `field < value` count using the framework's
+    plane-scan kernel (pilosa_tpu/ops/bsi.py) vmapped over shards."""
+    from pilosa_tpu.ops import bsi
+
+    bounds, oob = bsi._bound_args(abs(value), depth)
+
+    @jax.jit
+    def run(planes, exists, sign):
+        mask = jax.vmap(
+            lambda p, e, s: bsi._range_lt_kernel(
+                p, e, s, bounds, oob, negative=False, depth=depth, allow_eq=True
+            )
+        )(planes, exists, sign)
+        return jnp.sum(lax.population_count(mask).astype(jnp.int32))
+
+    return run
+
+
+def _np_bsi_lt(planes, exists, sign, value, depth):
+    """CPU baseline: the same bit-sliced scan in vectorized numpy."""
+    lt = np.zeros_like(exists)
+    eq = exists & ~sign
+    for k in reversed(range(depth)):
+        p = planes[:, k]
+        if (value >> k) & 1:
+            lt |= eq & ~p
+            eq = eq & p
+        else:
+            eq = eq & ~p
+    return int(np.bitwise_count((lt | eq) | (exists & sign)).sum())
+
+
 def main() -> None:
     accel = _on_accelerator()
     # Full size on the TPU chip (~10.7e9 bits = 1.34 GiB); small on CPU CI.
@@ -115,6 +148,30 @@ def main() -> None:
         lat.append(time.perf_counter() - t0)
     topn_p50_ms = sorted(lat)[len(lat) // 2] * 1e3
 
+    # -- BSI range (BASELINE config 3: int-field Range + count) -------------
+    D = 16
+    kp = jax.random.split(key, 3)
+    planes = jax.random.bits(kp[0], (S, D, W), dtype=jnp.uint32) & jax.random.bits(
+        kp[1], (S, D, W), dtype=jnp.uint32
+    )
+    exists = jnp.full((S, W), jnp.uint32(0xFFFFFFFF))
+    sign = jnp.zeros((S, W), jnp.uint32)
+    run_range = _bsi_range_fn(D, 12345)
+    int(run_range(planes, exists, sign))  # compile
+    n_rq = 20
+    t0 = time.perf_counter()
+    for _ in range(n_rq):
+        int(run_range(planes, exists, sign))
+    bsi_qps = n_rq / (time.perf_counter() - t0)
+
+    planes_sub = np.asarray(planes[: max(1, S // 16)])
+    ex_sub = np.asarray(exists[: max(1, S // 16)])
+    sg_sub = np.asarray(sign[: max(1, S // 16)])
+    t0 = time.perf_counter()
+    _np_bsi_lt(planes_sub, ex_sub, sg_sub, 12345, D)
+    cpu_bsi_t = (time.perf_counter() - t0) * (S / max(1, S // 16))
+    bsi_vs = bsi_qps * cpu_bsi_t
+
     # -- CPU baseline (numpy popcount on a shard subset, scaled) ------------
     S_sub = max(1, S // 16)
     sub = np.asarray(bits[:S_sub])  # [S_sub, R, W]
@@ -139,6 +196,8 @@ def main() -> None:
         "sequential_vs_baseline": round(seq_qps / cpu_qps, 1),
         "topn_p50_ms": round(topn_p50_ms, 2),
         "topn_vs_baseline": round(cpu_topn_ms / topn_p50_ms, 1),
+        "bsi_range_qps": round(bsi_qps, 1),
+        "bsi_range_vs_baseline": round(bsi_vs, 1),
         "cpu_baseline_qps": round(cpu_qps, 1),
         "platform": jax.devices()[0].platform,
         "index_bits": n_bits,

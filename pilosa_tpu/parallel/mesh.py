@@ -33,3 +33,38 @@ def default_mesh(n_devices: int | None = None, axis_names=("shards", "rows")) ->
         devices = devices[:n_devices]
     s, r = mesh_shape_for(len(devices))
     return Mesh(np.array(devices).reshape(s, r), axis_names)
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> Mesh:
+    """Join this process to a multi-host JAX job and return the global
+    mesh over every host's devices.
+
+    The reference scales across hosts with memberlist gossip + HTTP RPC
+    (SURVEY §2.4); the TPU-native data plane instead uses the JAX
+    distributed runtime: one coordinator process, XLA collectives riding
+    ICI within a slice and DCN across slices. The ``shards`` axis is laid
+    out so consecutive shards land on one host's devices first — keeping
+    the reduce step of a multi-shard query on ICI, with only the final
+    cross-host combine touching DCN.
+
+    Args default from the standard JAX env (JAX_COORDINATOR_ADDRESS etc.)
+    when omitted; on a single-host job this degrades to ``default_mesh``.
+    The cluster layer (HTTP membership, resize, anti-entropy) still runs
+    per-host for storage ownership — this function only wires the
+    device-compute plane.
+    """
+    import os
+
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+        jax.distributed.initialize()
+    return default_mesh()

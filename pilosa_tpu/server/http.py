@@ -47,6 +47,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
     ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),
     ("GET", re.compile(r"^/export$"), "export"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
@@ -184,6 +185,25 @@ class Handler(BaseHTTPRequestHandler):
         stats = self.api.holder.stats
         snap = stats.snapshot() if hasattr(stats, "snapshot") else {}
         self._send_json(200, snap)
+
+    def r_debug_threads(self):
+        """Per-thread stack dump — the pprof goroutine-profile analogue
+        (reference mounts net/http/pprof, http/handler.go:280)."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        out = []
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            out.append(
+                {
+                    "name": t.name,
+                    "daemon": t.daemon,
+                    "stack": traceback.format_stack(frame) if frame else [],
+                }
+            )
+        self._send_json(200, {"threads": out, "count": len(out)})
 
     def r_diagnostics(self):
         """Diagnostics snapshot (reference diagnostics.go payload; local
