@@ -960,6 +960,20 @@ class Executor:
         counts: dict[int, int] = {}
         src_count = src.count() if src is not None else 0
         row_totals: dict[int, int] = {}
+        if view is not None and src is None:
+            # No source filter: one row-scan launch over the cached field
+            # stack answers every shard at once (ops/kernels.py row_counts,
+            # replacing the reference's per-fragment cache merge).
+            stack = self._field_stack(field, shards)
+            if stack is not None:
+                from pilosa_tpu.ops import kernels
+
+                slot_of, bits = stack
+                rc = np.asarray(kernels.row_counts(bits)).astype(np.int64)
+                for rid, slot in slot_of.items():
+                    if rc[slot]:
+                        counts[rid] = int(rc[slot])
+                view = None  # stack covered every shard; skip the loop
         if view is not None:
             for shard in shards:
                 frag = view.fragment(shard)

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -229,6 +231,21 @@ def _row_counts_kernel(in_ref, out_ref):
         out_ref[0, :] = out_ref[0, :] + pc
 
 
+def _row_counts_per_shard_kernel(in_ref, out_ref):
+    w = pl.program_id(2)
+    pc = jnp.sum(
+        lax.population_count(in_ref[0]).astype(jnp.int32), axis=-1
+    )  # [ROW_BLOCK]
+
+    @pl.when(w == 0)
+    def _():
+        out_ref[0, :] = pc
+
+    @pl.when(w != 0)
+    def _():
+        out_ref[0, :] = out_ref[0, :] + pc
+
+
 @jax.jit
 def row_counts_pallas(bits: jax.Array) -> jax.Array:
     """``int32[R]`` popcount per row over all shards (TopN scan,
@@ -262,12 +279,66 @@ def row_counts_pallas(bits: jax.Array) -> jax.Array:
 
 
 @jax.jit
+def row_counts_per_shard_pallas(bits: jax.Array) -> jax.Array:
+    """``int32[S, R]`` per-shard row popcounts (int32-safe per shard);
+    used instead of the fused cross-shard sum when totals could pass
+    2^31 — callers sum in int64 host-side."""
+    S, R, W = bits.shape
+    rb = _ROW_BLOCK
+    pad = (-R) % rb
+    if pad:
+        bits = jnp.pad(bits, ((0, 0), (0, pad), (0, 0)))
+    Rp = R + pad
+    wb = _word_block(W)
+    out = pl.pallas_call(
+        _row_counts_per_shard_kernel,
+        grid=(Rp // rb, S, W // wb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, rb, wb),
+                lambda r, s, w: (s, r, w),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rb),
+            lambda r, s, w: (s, r),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, Rp), jnp.int32),
+        interpret=_interpret(),
+    )(bits)
+    return out[:, :R]
+
+
+@jax.jit
 def row_counts_xla(bits: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=(0, 2))
 
 
-def row_counts(bits: jax.Array) -> jax.Array:
-    return _try_pallas(row_counts_pallas, row_counts_xla, bits)
+@jax.jit
+def row_counts_per_shard_xla(bits: jax.Array) -> jax.Array:
+    return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=2)
+
+
+def _int32_safe(bits) -> bool:
+    """Cross-shard per-row totals fit int32 when S * shard_bits < 2^31."""
+    S, _, W = bits.shape
+    return S * W * 32 < 2**31
+
+
+def row_counts(bits: jax.Array):
+    """Per-row popcounts over all shards.
+
+    Returns an ``int32[R]`` device array on the fused path, or an
+    ``int64[R]`` numpy array when cross-shard totals could overflow
+    int32 (per-shard device partials summed host-side)."""
+    if _int32_safe(bits):
+        return _try_pallas(row_counts_pallas, row_counts_xla, bits)
+    partials = _try_pallas(
+        row_counts_per_shard_pallas, row_counts_per_shard_xla, bits
+    )
+    return np.asarray(partials).astype(np.int64).sum(axis=0)
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -282,7 +353,13 @@ def _topn_xla(bits: jax.Array, *, n: int):
 
 def topn_counts(bits: jax.Array, n: int):
     """(top-n counts, row slots) fused with the row scan in one launch
-    (reference fragment.go:1568-1700 TopN over the ranked cache)."""
-    return _try_pallas(
-        partial(_topn_pallas, n=n), partial(_topn_xla, n=n), bits
-    )
+    (reference fragment.go:1568-1700 TopN over the ranked cache). Falls
+    back to host-side int64 selection when totals could overflow int32."""
+    if _int32_safe(bits):
+        return _try_pallas(
+            partial(_topn_pallas, n=n), partial(_topn_xla, n=n), bits
+        )
+    counts = row_counts(bits)  # int64 numpy on this path
+    n = min(n, counts.shape[0])
+    slots = np.argsort(-counts, kind="stable")[:n]
+    return counts[slots], slots

@@ -103,3 +103,29 @@ def test_dispatch_wrappers_run():
     rbs = jnp.asarray([1, 1], jnp.int32)
     assert kernels.pair_count_batched(bits, ras, rbs).shape == (2, 2)
     assert kernels.row_counts(bits).shape == (3,)
+
+
+def test_row_counts_per_shard_matches_numpy():
+    rng = np.random.default_rng(21)
+    bits = _rand_bits(rng, 3, 9, 256)
+    got = np.asarray(kernels.row_counts_per_shard_pallas(jnp.asarray(bits)))
+    want = np.bitwise_count(bits).sum(axis=2)
+    assert got.tolist() == want.tolist()
+    got_x = np.asarray(kernels.row_counts_per_shard_xla(jnp.asarray(bits)))
+    assert got_x.tolist() == want.tolist()
+
+
+def test_overflow_safe_paths(monkeypatch):
+    """When totals could pass int32, dispatchers switch to per-shard
+    partials + host int64 math and still return correct values."""
+    rng = np.random.default_rng(22)
+    bits = _rand_bits(rng, 2, 5, 128)
+    want = np.bitwise_count(bits).sum(axis=(0, 2))
+    monkeypatch.setattr(kernels, "_int32_safe", lambda b: False)
+    rc = kernels.row_counts(jnp.asarray(bits))
+    assert rc.dtype == np.int64
+    assert rc.tolist() == want.tolist()
+    counts, slots = kernels.topn_counts(jnp.asarray(bits), 3)
+    order = np.argsort(-want, kind="stable")[:3]
+    assert list(slots) == list(order)
+    assert list(counts) == [int(want[s]) for s in order]
