@@ -108,6 +108,16 @@ class Handler(BaseHTTPRequestHandler):
             raise ApiError(f"invalid json: {e}")
 
     def _dispatch(self, method: str) -> None:
+        if getattr(type(self), "paused", None) is not None and type(self).paused.is_set():
+            # Fault injection: emulate a paused process (reference uses
+            # pumba pause in internal/clustertests) — drop the connection
+            # without responding so clients see timeouts/resets.
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return
         parsed = urlparse(self.path)
         self.query_params = parse_qs(parsed.query)
         for m, rx, name in _ROUTES:
@@ -352,10 +362,27 @@ class Server:
     """HTTP server wrapper: bind, serve in background, close."""
 
     def __init__(self, api: API, host: str = "localhost", port: int = 10101, long_query_time: float = 0.0):
-        handler = type("BoundHandler", (Handler,), {"api": api, "long_query_time": long_query_time})
+        handler = type(
+            "BoundHandler",
+            (Handler,),
+            {
+                "api": api,
+                "long_query_time": long_query_time,
+                "paused": threading.Event(),
+            },
+        )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.api = api
         self._thread: threading.Thread | None = None
+
+    def pause(self) -> None:
+        """Stop answering requests (connections drop) until resume() —
+        fault injection mirroring pumba pause in the reference's
+        internal/clustertests."""
+        self.httpd.RequestHandlerClass.paused.set()
+
+    def resume(self) -> None:
+        self.httpd.RequestHandlerClass.paused.clear()
 
     @property
     def port(self) -> int:

@@ -136,6 +136,14 @@ class InProcessCluster:
         pause in internal/clustertests)."""
         self.nodes[i].stop()
 
+    def pause_node(self, i: int) -> None:
+        """Make a node drop all requests without stopping it (the pumba
+        pause analogue: process alive, network dead)."""
+        self.nodes[i].server.pause()
+
+    def resume_node(self, i: int) -> None:
+        self.nodes[i].server.resume()
+
     def close(self) -> None:
         for s in self.nodes:
             try:
