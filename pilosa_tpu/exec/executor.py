@@ -162,29 +162,35 @@ class Executor:
 
     def _field_stack(self, field: Field, shards: list[int]):
         """(slot_of, bits[S, R, W] device tensor) for the field's standard
-        view over ``shards``, cached on the field and invalidated by any
-        fragment mutation (version counters). None when over budget or
-        empty."""
+        view, DENSE over ``shards`` (all-zero slices where a shard has no
+        fragment, so stacks of different fields share the shard axis —
+        the GroupBy cross-field kernel needs that alignment). Cached on
+        the field; invalidated by any fragment mutation (version
+        counters) or membership change in ``shards``. None when over
+        budget or empty."""
         v = field.view(VIEW_STANDARD)
-        frags = [(s, v.fragments[s]) for s in shards if s in v.fragments]
+        frags = {s: v.fragments[s] for s in shards if s in v.fragments}
         if not frags:
             return None
         key = (
-            tuple(s for s, _ in frags),
-            tuple(f.version for _, f in frags),
+            tuple(shards),
+            tuple(frags[s].version if s in frags else -1 for s in shards),
         )
         cache = getattr(field, "_stack_cache", None)
         if cache is not None and cache[0] == key:
             return cache[1], cache[2]
-        row_ids = sorted({r for _, f in frags for r in f.row_ids()})
+        row_ids = sorted({r for f in frags.values() for r in f.row_ids()})
         if not row_ids:
             return None
-        S, R, W = len(frags), len(row_ids), field.n_words
+        S, R, W = len(shards), len(row_ids), field.n_words
         if S * R * W * 4 > _STACK_BUDGET_BYTES:
             return None
         slot_of = {r: i for i, r in enumerate(row_ids)}
         bits = np.zeros((S, R, W), dtype=np.uint32)
-        for si, (_, f) in enumerate(frags):
+        for si, s in enumerate(shards):
+            f = frags.get(s)
+            if f is None:
+                continue
             for r in f.row_ids():
                 bits[si, slot_of[r]] = f.row_words_host(r)
         dev = jnp.asarray(bits)
@@ -1145,6 +1151,20 @@ class Executor:
 
         results: list[GroupCount] = []
         use_limit = has_limit and limit > 0
+
+        # Two-level cross-field fast path: all combination counts in one
+        # batched device launch over aligned field stacks (reference runs
+        # one intersectionCount per combo, executor.go:3208-3211).
+        if (
+            len(levels) == 2
+            and filt_row is None
+            and not has_prev
+            and all(f.view(VIEW_STANDARD) is not None for _, f, _ in levels)
+        ):
+            fast = self._groupby_two_level_batch(idx, levels, shards)
+            if fast is not None:
+                return fast[: limit if use_limit else len(fast)]
+
         # one device gather per (level, row), not per combination
         row_cache: dict[tuple[int, int], Row] = {}
 
@@ -1191,6 +1211,69 @@ class Executor:
 
         recurse(0, None, [], has_prev)
         return results
+
+    _GROUPBY_BATCH_MAX = 65536
+
+    def _groupby_two_level_batch(
+        self, idx: Index, levels, shards: list[int]
+    ) -> list[GroupCount] | None:
+        """All (row1, row2) combination counts in one launch; None when
+        stacks are unavailable or the combo count is too large."""
+        from pilosa_tpu.ops import kernels
+
+        (f1name, f1, rows1), (f2name, f2, rows2) = levels
+        n_combo = len(rows1) * len(rows2)
+        if n_combo == 0:
+            return []
+        if n_combo > self._GROUPBY_BATCH_MAX:
+            return None
+        s1 = self._field_stack(f1, shards)
+        s2 = self._field_stack(f2, shards) if f2 is not f1 else s1
+        if s1 is None or s2 is None:
+            return None
+        slot1, bits1 = s1
+        slot2, bits2 = s2
+        combos = [
+            (r1, r2)
+            for r1 in rows1
+            for r2 in rows2
+            if r1 in slot1 and r2 in slot2
+        ]
+        if not combos:
+            return []
+        B = 1 << (len(combos) - 1).bit_length()
+        ras = np.zeros(B, dtype=np.int32)
+        rbs = np.zeros(B, dtype=np.int32)
+        for j, (r1, r2) in enumerate(combos):
+            ras[j], rbs[j] = slot1[r1], slot2[r2]
+        with tracing.start_span("executor.groupByBatch").set_tag(
+            "n", len(combos)
+        ):
+            if f2 is f1:
+                partials = kernels.pair_count_batched(
+                    bits1, jnp.asarray(ras), jnp.asarray(rbs)
+                )
+            else:
+                partials = kernels.pair_count_two_batched(
+                    bits1, bits2, jnp.asarray(ras), jnp.asarray(rbs)
+                )
+            counts = (
+                np.asarray(partials).astype(np.int64).sum(axis=1)
+            )
+        out = []
+        for j, (r1, r2) in enumerate(combos):
+            c = int(counts[j])
+            if c > 0:
+                out.append(
+                    GroupCount(
+                        group=[
+                            FieldRow(field=f1name, row_id=r1),
+                            FieldRow(field=f2name, row_id=r2),
+                        ],
+                        count=c,
+                    )
+                )
+        return out
 
     # --------------------------------------------------------------- Options
 

@@ -211,6 +211,85 @@ def pair_count_batched(
 
 
 # ---------------------------------------------------------------------------
+# Two-tensor pair count: Count(op(A.Row(ra[i]), B.Row(rb[i])))  (GroupBy)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("op",))
+def pair_count_two_batched_pallas(
+    bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
+    *, op: str = "intersect",
+) -> jax.Array:
+    """``int32[B, S]`` per-shard counts of
+    ``popcount(op(bits_a[:, ras[i]], bits_b[:, rbs[i]]))``.
+
+    The cross-field shape of GroupBy's combination counts (reference
+    executor.go:3208-3211 counts the intersection of the last two
+    levels); both stacks must share the shard axis."""
+    S, _, W = bits_a.shape
+    B = ras.shape[0]
+    wb = _word_block(W)
+    grid = (B, S, W // wb)
+    kernel = partial(_pair_count_kernel, op)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, wb),
+                    lambda b, s, w, ras_ref, rbs_ref: (s, ras_ref[b], w),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, 1, wb),
+                    lambda b, s, w, ras_ref, rbs_ref: (s, rbs_ref[b], w),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1),
+                lambda b, s, w, ras_ref, rbs_ref: (b, s),
+                memory_space=pltpu.SMEM,
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, S), jnp.int32),
+        interpret=_interpret(),
+    )(ras.astype(jnp.int32), rbs.astype(jnp.int32), bits_a, bits_b)
+
+
+@partial(jax.jit, static_argnames=("op",))
+def pair_count_two_batched_xla(
+    bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
+    *, op: str = "intersect",
+) -> jax.Array:
+    def body(_, q):
+        ra, rb = q
+        words = _OPS[op](bits_a[:, ra], bits_b[:, rb])
+        return None, jnp.sum(
+            lax.population_count(words).astype(jnp.int32), axis=-1
+        )
+
+    _, counts = lax.scan(body, None, (ras, rbs))
+    return counts
+
+
+def pair_count_two_batched(
+    bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
+    *, op: str = "intersect",
+) -> jax.Array:
+    return _try_pallas(
+        partial(pair_count_two_batched_pallas, op=op),
+        partial(pair_count_two_batched_xla, op=op),
+        bits_a,
+        bits_b,
+        ras,
+        rbs,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Row-scan popcount: counts[r] = sum_s sum_w popcount(bits[s, r, w])
 # ---------------------------------------------------------------------------
 

@@ -128,3 +128,28 @@ def test_shards_argument_respected(setup):
     assert all(a >= b for a, b in zip(all_shards, only0))
     per = [ex.execute("i", _pairs_query([p]), shards=[0])[0] for p in [(0, 1), (2, 3)]]
     assert only0 == per
+
+
+def test_groupby_fast_path_matches_recursive(setup):
+    _, ex = setup
+
+    def norm(res):
+        return [
+            ([(fr.field, fr.row_id) for fr in gc.group], gc.count) for gc in res
+        ]
+
+    queries = [
+        "GroupBy(Rows(f), Rows(g))",
+        "GroupBy(Rows(g), Rows(f))",
+        "GroupBy(Rows(f), Rows(f))",
+        "GroupBy(Rows(f), Rows(g), limit=3)",
+    ]
+    for q in queries:
+        fast = ex.execute("i", q)[0]
+        old_max = ex._GROUPBY_BATCH_MAX
+        try:
+            ex._GROUPBY_BATCH_MAX = 0  # force the recursive path
+            slow = ex.execute("i", q)[0]
+        finally:
+            ex._GROUPBY_BATCH_MAX = old_max
+        assert norm(fast) == norm(slow), q
