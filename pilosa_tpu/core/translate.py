@@ -29,6 +29,10 @@ class TranslateStore:
         # (index, field) -> key -> id; field "" means column keys.
         self._ids: dict[tuple[str, str], dict[str, int]] = {}
         self._keys: dict[tuple[str, str], list[str]] = {}
+        # Called under the lock for every new (key, id) mapping — the
+        # storage layer appends these to the on-disk log (reference
+        # translate.go:37-40 InsertColumn/InsertRow entries).
+        self.on_insert = None  # fn(index, field, key, id)
 
     def _space(self, index: str, field: str):
         ids = self._ids.setdefault((index, field), {})
@@ -54,6 +58,8 @@ class TranslateStore:
                     id_ = len(key_list) + 1
                     ids[k] = id_
                     key_list.append(k)
+                    if self.on_insert is not None:
+                        self.on_insert(index, field, k, id_)
                 out.append(id_)
             return out
 
@@ -83,8 +89,11 @@ class TranslateStore:
                     continue
                 while len(key_list) < i:
                     key_list.append("")
+                changed = key_list[i - 1] != k
                 key_list[i - 1] = k
                 ids[k] = i
+                if changed and self.on_insert is not None:
+                    self.on_insert(index, field, k, i)
 
     # -- persistence --------------------------------------------------------
 

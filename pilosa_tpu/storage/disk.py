@@ -28,6 +28,7 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.storage.fragmentfile import FragmentFile, SnapshotQueue
+from pilosa_tpu.storage.translatelog import TranslateLog
 
 
 class HolderStore:
@@ -37,6 +38,7 @@ class HolderStore:
         self.holder = holder
         self.path = path
         self.translator = TranslateStore()
+        self.translate_log: TranslateLog | None = None
         self.snapshot_queue = SnapshotQueue(workers=snapshot_workers)
         self._stores: list[FragmentFile] = []
         os.makedirs(path, exist_ok=True)
@@ -98,10 +100,25 @@ class HolderStore:
     def open(self) -> None:
         """Walk the directory tree, rebuild schema + load every fragment
         (reference holder.go:134-198)."""
-        keys_path = os.path.join(self.path, ".keys.json")
-        if os.path.exists(keys_path):
-            with open(keys_path) as f:
+        # Key translation: append-only log (reference translate.go
+        # TranslateFile .keys). A legacy .keys.json snapshot migrates into
+        # the log on first open.
+        legacy_path = os.path.join(self.path, ".keys.json")
+        if os.path.exists(legacy_path):
+            with open(legacy_path) as f:
                 self.translator.load_dict(json.load(f))
+        self.translate_log = TranslateLog(
+            self.translator, os.path.join(self.path, ".keys")
+        )
+        self.translate_log.open()
+        if os.path.exists(legacy_path):
+            # re-emit the legacy snapshot as log records, then drop it
+            for joined, key_list in self.translator.to_dict().items():
+                index, _, field = joined.partition("|")
+                for i, k in enumerate(key_list):
+                    if k != "":
+                        self.translate_log._append(index, field, k, i + 1)
+            os.remove(legacy_path)
         for index_name in sorted(os.listdir(self.path)):
             index_dir = self._index_dir(index_name)
             meta_path = os.path.join(index_dir, ".meta.json")
@@ -156,9 +173,9 @@ class HolderStore:
 
     def sync(self) -> None:
         """Flush schema, attrs, and translation to disk (fragment data is
-        already durable via op logs)."""
-        with open(os.path.join(self.path, ".keys.json"), "w") as f:
-            json.dump(self.translator.to_dict(), f)
+        already durable via op logs; key translation via its own log)."""
+        if self.translate_log is not None:
+            self.translate_log.sync()
         for idx in self.holder.indexes.values():
             index_dir = self._index_dir(idx.name)
             os.makedirs(index_dir, exist_ok=True)
@@ -228,6 +245,8 @@ class HolderStore:
 
     def close(self) -> None:
         self.sync()
+        if self.translate_log is not None:
+            self.translate_log.close()
         self.snapshot_queue.await_all()
         self.snapshot_queue.stop()
         for store in self._stores:

@@ -261,3 +261,67 @@ class TestStorageReviewRegressions2:
         store.delete_index_dir("i")
         assert frag.store is None
         assert len(store._stores) < n_before
+
+
+class TestTranslateLog:
+    def test_append_and_replay(self, tmp_path):
+        from pilosa_tpu.core.translate import TranslateStore
+        from pilosa_tpu.storage.translatelog import TranslateLog
+
+        store = TranslateStore()
+        log = TranslateLog(store, str(tmp_path / ".keys"))
+        log.open()
+        assert store.translate_keys("i", "", ["alpha", "beta"]) == [1, 2]
+        assert store.translate_keys("i", "f", ["x"]) == [1]
+        log.close()
+
+        store2 = TranslateStore()
+        log2 = TranslateLog(store2, str(tmp_path / ".keys"))
+        log2.open()
+        assert store2.translate_keys("i", "", ["alpha", "beta"], create=False) == [1, 2]
+        assert store2.translate_id("i", "f", 1) == "x"
+        # new allocations continue after the replayed ids
+        assert store2.translate_keys("i", "", ["gamma"]) == [3]
+        log2.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        from pilosa_tpu.core.translate import TranslateStore
+        from pilosa_tpu.storage.translatelog import TranslateLog
+
+        p = str(tmp_path / ".keys")
+        store = TranslateStore()
+        log = TranslateLog(store, p)
+        log.open()
+        store.translate_keys("i", "", ["good"])
+        log.close()
+        with open(p, "ab") as f:
+            f.write(b"\x01\x02")  # torn record
+        store2 = TranslateStore()
+        log2 = TranslateLog(store2, p)
+        log2.open()
+        assert store2.translate_key("i", "", "good", create=False) == 1
+        # appends after truncation land on a clean record boundary
+        assert store2.translate_keys("i", "", ["next"]) == [2]
+        log2.close()
+        store3 = TranslateStore()
+        log3 = TranslateLog(store3, p)
+        log3.open()
+        assert store3.translate_key("i", "", "next", create=False) == 2
+        log3.close()
+
+    def test_holderstore_keys_survive_reopen(self, tmp_path):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.storage.disk import HolderStore
+
+        h = Holder()
+        hs = HolderStore(h, str(tmp_path))
+        hs.open()
+        h.create_index("ki", keys=True)
+        assert hs.translator.translate_keys("ki", "", ["u1", "u2"]) == [1, 2]
+        hs.close()
+
+        h2 = Holder()
+        hs2 = HolderStore(h2, str(tmp_path))
+        hs2.open()
+        assert hs2.translator.translate_key("ki", "", "u2", create=False) == 2
+        hs2.close()
