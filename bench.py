@@ -22,13 +22,49 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 from functools import partial
 
 import numpy as np
 
+
+def _accelerator_alive() -> bool:
+    """Probe device init in a subprocess: a dead TPU tunnel makes
+    jax.devices() hang forever, which must not hang the benchmark."""
+    # DEVNULL, not pipes: a killed child can leave grandchildren (tunnel
+    # helpers) holding inherited pipe ends, which would make run() block
+    # past its timeout waiting for EOF.
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=120,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return r.returncode == 0
+    except subprocess.SubprocessError:
+        return False
+
+
+_FORCED_CPU = False
+if "cpu" not in os.environ.get("JAX_PLATFORMS", "") and not _accelerator_alive():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _FORCED_CPU = True
+
 import jax
+
+if _FORCED_CPU:
+    # sitecustomize may pin the accelerator platform at import; the env
+    # var alone does not override it.
+    jax.config.update("jax_platforms", "cpu")
+    print(
+        "warning: accelerator unreachable, benchmarking on CPU",
+        file=sys.stderr,
+    )
+
 import jax.numpy as jnp
 from jax import lax
 
