@@ -62,7 +62,12 @@ inline void push_le(std::vector<uint8_t>& out, T v) {
 struct Reader {
   const uint8_t* data;
   size_t len;
-  bool ok(size_t off, size_t need) const { return off + need <= len; }
+  // Subtraction form: `off + need <= len` wraps for attacker-controlled
+  // lengths near SIZE_MAX, letting the check pass and the read run off
+  // the buffer.
+  bool ok(size_t off, size_t need) const {
+    return off <= len && need <= len - off;
+  }
 };
 
 // -- container decode -------------------------------------------------------
@@ -138,7 +143,8 @@ void apply_ops(const Reader& r, size_t pos, std::vector<uint64_t>* positions,
       (*op_count)++;
       pos += 13;
     } else if (op == kOpAddBatch || op == kOpRemoveBatch) {
-      size_t payload = value * 8;
+      if (value > r.len / 8) break;  // value*8 must not wrap
+      size_t payload = size_t(value) * 8;
       if (!r.ok(pos + 13, payload)) break;
       if (fnv32a(h, r.data + pos + 13, payload) != chk) break;
       materialize();
@@ -152,7 +158,8 @@ void apply_ops(const Reader& r, size_t pos, std::vector<uint64_t>* positions,
       *op_count += value;
       pos += 13 + payload;
     } else if (op == kOpAddRoaring || op == kOpRemoveRoaring) {
-      if (!r.ok(pos + 13, 4 + value)) break;
+      if (value > r.len) break;  // 4+value must not wrap
+      if (!r.ok(pos + 13, 4) || !r.ok(pos + 17, value)) break;
       uint32_t h2 = fnv32a(h, r.data + pos + 13, 4);  // opN tail
       if (fnv32a(h2, r.data + pos + 17, value) != chk) break;
       uint32_t op_n = load_le<uint32_t>(r.data + pos + 13);

@@ -19,6 +19,7 @@ from typing import Any
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from pilosa_tpu import pql
@@ -75,6 +76,14 @@ _WRITE_CALLS = {
     "SetRowAttrs",
     "SetColumnAttrs",
 }
+
+
+def _is_write(call: Call) -> bool:
+    """A call writes if it or any descendant writes — Options() (and any
+    future wrapper) can wrap a write, so the barrier walks the tree."""
+    if call.name in _WRITE_CALLS:
+        return True
+    return any(_is_write(c) for c in call.children)
 
 
 class ExecuteError(Exception):
@@ -164,15 +173,26 @@ class Executor:
         """(slot_of, bits[S, R, W] device tensor) for the field's standard
         view, DENSE over ``shards`` (all-zero slices where a shard has no
         fragment, so stacks of different fields share the shard axis —
-        the GroupBy cross-field kernel needs that alignment). Cached on
-        the field; invalidated by any fragment mutation (version
-        counters) or membership change in ``shards``. None when over
-        budget or empty."""
+        the GroupBy cross-field kernel needs that alignment). With more
+        than one device visible the stack is laid out over the serving
+        mesh — NamedSharding(mesh, P("shards")) with the shard axis
+        padded to the mesh size — so every batched kernel runs on all
+        chips (the reference's shard→node mapReduce, executor.go:2454,
+        as a static placement). Cached on the field; invalidated by any
+        fragment mutation (version counters) or membership change in
+        ``shards``. None when over budget or empty."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from pilosa_tpu.parallel.mesh import serving_mesh
+
         v = field.view(VIEW_STANDARD)
         frags = {s: v.fragments[s] for s in shards if s in v.fragments}
         if not frags:
             return None
+        mesh = serving_mesh()
+        # The mesh is part of the key: a device-set/configure_serving
+        # change must invalidate stacks built with the old sharding.
         key = (
+            mesh,
             tuple(shards),
             tuple(frags[s].version if s in frags else -1 for s in shards),
         )
@@ -183,6 +203,9 @@ class Executor:
         if not row_ids:
             return None
         S, R, W = len(shards), len(row_ids), field.n_words
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            S = -(-S // n_dev) * n_dev  # pad so the mesh divides the axis
         if S * R * W * 4 > _STACK_BUDGET_BYTES:
             return None
         slot_of = {r: i for i, r in enumerate(row_ids)}
@@ -193,7 +216,12 @@ class Executor:
                 continue
             for r in f.row_ids():
                 bits[si, slot_of[r]] = f.row_words_host(r)
-        dev = jnp.asarray(bits)
+        if mesh is not None:
+            dev = jax.device_put(
+                bits, NamedSharding(mesh, PartitionSpec("shards", None, None))
+            )
+        else:
+            dev = jnp.asarray(bits)
         field._stack_cache = (key, slot_of, dev)
         return slot_of, dev
 
@@ -216,7 +244,7 @@ class Executor:
         from pilosa_tpu.ops import kernels
 
         first_write = next(
-            (i for i, c in enumerate(calls) if c.name in _WRITE_CALLS),
+            (i for i, c in enumerate(calls) if _is_write(c)),
             len(calls),
         )
         by_field: dict[str, list[tuple[int, str, int, int]]] = {}

@@ -25,7 +25,7 @@ unavailable for a backend.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 # Largest word-block a grid step streams into VMEM (uint32 words). 32768
 # words = one full 2^20-bit shard row = 128 KiB; two input rows double-
@@ -166,18 +168,113 @@ def _multi_device(x) -> bool:
 
     pallas_call is not sharding-aware: feeding it a NamedSharding'd stack
     would either fail or make XLA replicate the full bitmap onto every
-    device — exactly the materialization the mesh layout avoids.  Those
-    arrays keep the fused-XLA path, whose jnp ops partition over the mesh
-    and reduce over ICI."""
+    device — exactly the materialization the mesh layout avoids.  Arrays
+    sharded over a leading ``shards``-style mesh axis take the shard_map
+    path below (per-device Pallas on TPU); anything else multi-device
+    keeps the fused-XLA path, whose jnp ops partition over the mesh and
+    reduce over ICI."""
     try:
         return len(x.sharding.device_set) > 1
     except AttributeError:
         return False
 
 
+def shards_axis_of(x):
+    """(mesh, axis_name) when ``x`` is NamedSharding'd with ONLY its
+    leading dimension split over one mesh axis — the serving-stack layout
+    (executor field stacks: P("shards", None, ...)).  None otherwise."""
+    s = getattr(x, "sharding", None)
+    if not isinstance(s, NamedSharding) or len(s.device_set) <= 1:
+        return None
+    spec = tuple(s.spec)
+    if not spec or spec[0] is None:
+        return None
+    first = spec[0]
+    if isinstance(first, (tuple, list)):
+        if len(first) != 1:
+            return None
+        first = first[0]
+    if not isinstance(first, str):
+        return None
+    if any(p is not None for p in spec[1:]):
+        return None
+    return s.mesh, first
+
+
+@lru_cache(maxsize=64)
+def _pair_count_sharded_fn(mesh, axis, op, two_tensor, use_pallas):
+    """jit(shard_map) answering a pair-count batch over a shards-sharded
+    stack: each device runs the single-device kernel (Pallas on TPU, XLA
+    scan elsewhere) on its local shard block; per-shard partials
+    concatenate back along the shard axis — the ICI replacement for the
+    reference's per-node mapReduce fan-out (executor.go:2454-2611)."""
+    if two_tensor:
+        local = partial(
+            pair_count_two_batched_pallas
+            if use_pallas
+            else pair_count_two_batched_xla,
+            op=op,
+        )
+        in_specs = (P(axis, None, None), P(axis, None, None), P(None), P(None))
+    else:
+        local = partial(
+            pair_count_batched_pallas if use_pallas else pair_count_batched_xla,
+            op=op,
+        )
+        in_specs = (P(axis, None, None), P(None), P(None))
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(None, axis),
+        )
+    )
+
+
+@lru_cache(maxsize=64)
+def _row_counts_sharded_fn(mesh, axis, use_pallas):
+    """jit(shard_map) per-shard row popcounts over a shards-sharded stack
+    -> int32[S, R] laid out along the mesh axis."""
+    local = (
+        row_counts_per_shard_pallas if use_pallas else row_counts_per_shard_xla
+    )
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None, None),),
+            out_specs=P(axis, None),
+        )
+    )
+
+
+def _run_sharded(builder, builder_args, call_args) -> jax.Array:
+    """Invoke a sharded kernel with the same Pallas→XLA degradation
+    contract as _try_pallas: a Pallas compile/runtime failure demotes and
+    re-answers with the XLA local kernel instead of failing the query."""
+    global _pallas_ok
+    use_pallas = pallas_supported() and _pallas_ok is not False
+    if use_pallas:
+        try:
+            out = builder(*builder_args, True)(*call_args)
+            if _pallas_ok is None:
+                jax.block_until_ready(out)
+                _pallas_ok = True
+            return out
+        except Exception:
+            # match _try_pallas: an established True flag survives a
+            # one-off shape failure; only an unproven backend demotes
+            if _pallas_ok is None:
+                _pallas_ok = False
+    return builder(*builder_args, False)(*call_args)
+
+
 def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
-    """Run the Pallas kernel, permanently demoting to the XLA fallback if
-    the backend rejects it (first call decides; jit caches the rest)."""
+    """Run the Pallas kernel, falling back to fused XLA on ANY failure.
+    The permanent flag only decides whether to *try* Pallas next time —
+    one bad shape/op must never fail a query that the fallback can
+    answer."""
     global _pallas_ok
     if (
         _pallas_ok is False
@@ -194,13 +291,18 @@ def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
     except Exception:
         if _pallas_ok is None:
             _pallas_ok = False
-            return fallback(*args, **kwargs)
-        raise
+        return fallback(*args, **kwargs)
 
 
 def pair_count_batched(
     bits: jax.Array, ras: jax.Array, rbs: jax.Array, *, op: str = "intersect"
 ) -> jax.Array:
+    m = shards_axis_of(bits)
+    if m is not None:
+        mesh, axis = m
+        return _run_sharded(
+            _pair_count_sharded_fn, (mesh, axis, op, False), (bits, ras, rbs)
+        )
     return _try_pallas(
         partial(pair_count_batched_pallas, op=op),
         partial(pair_count_batched_xla, op=op),
@@ -279,6 +381,14 @@ def pair_count_two_batched(
     bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
     *, op: str = "intersect",
 ) -> jax.Array:
+    m = shards_axis_of(bits_a)
+    if m is not None and shards_axis_of(bits_b) == m:
+        mesh, axis = m
+        return _run_sharded(
+            _pair_count_sharded_fn,
+            (mesh, axis, op, True),
+            (bits_a, bits_b, ras, rbs),
+        )
     return _try_pallas(
         partial(pair_count_two_batched_pallas, op=op),
         partial(pair_count_two_batched_xla, op=op),
@@ -411,7 +521,12 @@ def row_counts(bits: jax.Array):
 
     Returns an ``int32[R]`` device array on the fused path, or an
     ``int64[R]`` numpy array when cross-shard totals could overflow
-    int32 (per-shard device partials summed host-side)."""
+    int32 or the stack is mesh-sharded (per-shard device partials summed
+    host-side)."""
+    m = shards_axis_of(bits)
+    if m is not None:
+        partials = _run_sharded(_row_counts_sharded_fn, m, (bits,))
+        return np.asarray(partials).astype(np.int64).sum(axis=0)
     if _int32_safe(bits):
         return _try_pallas(row_counts_pallas, row_counts_xla, bits)
     partials = _try_pallas(
@@ -433,8 +548,9 @@ def _topn_xla(bits: jax.Array, *, n: int):
 def topn_counts(bits: jax.Array, n: int):
     """(top-n counts, row slots) fused with the row scan in one launch
     (reference fragment.go:1568-1700 TopN over the ranked cache). Falls
-    back to host-side int64 selection when totals could overflow int32."""
-    if _int32_safe(bits):
+    back to host-side int64 selection when totals could overflow int32
+    or the stack is mesh-sharded."""
+    if shards_axis_of(bits) is None and _int32_safe(bits):
         return _try_pallas(
             partial(_topn_pallas, n=n), partial(_topn_xla, n=n), bits
         )

@@ -35,6 +35,39 @@ def default_mesh(n_devices: int | None = None, axis_names=("shards", "rows")) ->
     return Mesh(np.array(devices).reshape(s, r), axis_names)
 
 
+_serving_mesh: Mesh | None = None
+_serving_max_devices: int | None = None
+
+
+def configure_serving(max_devices: int | None) -> None:
+    """Cap the serving mesh at the first ``max_devices`` devices (None =
+    all). The analogue of the reference's cluster-size config; also lets
+    a dryrun model an exact device count on a larger virtual backend."""
+    global _serving_max_devices, _serving_mesh
+    _serving_max_devices = max_devices
+    _serving_mesh = None
+
+
+def serving_mesh() -> Mesh | None:
+    """1-D ``("shards",)`` mesh over the visible devices, used by the
+    serving executor's field stacks so each device owns a contiguous
+    slice of shards — the reference's shard→node placement
+    (cluster.go:858-934) made static. None on a single-device host (the
+    plain single-device path is faster than a degenerate mesh)."""
+    global _serving_mesh
+    # local_devices, not devices: each process serves the shards it owns
+    # (the cluster layer routes cross-host queries); a mesh spanning
+    # non-addressable devices would make device_put raise mid-query.
+    devices = jax.local_devices()
+    if _serving_max_devices is not None:
+        devices = devices[:_serving_max_devices]
+    if len(devices) <= 1:
+        return None
+    if _serving_mesh is None or list(_serving_mesh.devices.flat) != devices:
+        _serving_mesh = Mesh(np.array(devices), ("shards",))
+    return _serving_mesh
+
+
 def init_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

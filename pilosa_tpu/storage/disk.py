@@ -104,20 +104,38 @@ class HolderStore:
         # TranslateFile .keys). A legacy .keys.json snapshot migrates into
         # the log on first open.
         legacy_path = os.path.join(self.path, ".keys.json")
+        legacy = None
         if os.path.exists(legacy_path):
             with open(legacy_path) as f:
-                self.translator.load_dict(json.load(f))
+                legacy = json.load(f)
         self.translate_log = TranslateLog(
             self.translator, os.path.join(self.path, ".keys")
         )
         self.translate_log.open()
-        if os.path.exists(legacy_path):
-            # re-emit the legacy snapshot as log records, then drop it
-            for joined, key_list in self.translator.to_dict().items():
+        if legacy is not None:
+            # Migrate the legacy snapshot into the log, skipping mappings
+            # the log replay already installed — a crash between append and
+            # os.remove must not duplicate the whole snapshot on the next
+            # open (replay is idempotent, but the log would grow unboundedly
+            # across crash loops).
+            replayed = self.translator.to_dict()
+            for joined, key_list in legacy.items():
                 index, _, field = joined.partition("|")
-                for i, k in enumerate(key_list):
-                    if k != "":
-                        self.translate_log._append(index, field, k, i + 1)
+                have = replayed.get(joined, [])
+                keys = [k for k in key_list if k != ""]
+                ids = [i + 1 for i, k in enumerate(key_list) if k != ""]
+                missing_k = []
+                missing_i = []
+                for k, i in zip(keys, ids):
+                    if i > len(have) or have[i - 1] != k:
+                        missing_k.append(k)
+                        missing_i.append(i)
+                # set_mapping installs in memory and (via on_insert, hooked
+                # by translate_log.open) appends only the missing records.
+                if missing_k:
+                    self.translator.set_mapping(
+                        index, field, missing_k, missing_i
+                    )
             os.remove(legacy_path)
         for index_name in sorted(os.listdir(self.path)):
             index_dir = self._index_dir(index_name)

@@ -120,6 +120,23 @@ def test_writes_before_counts_are_observed(setup):
     assert post23 == pre23
 
 
+def test_options_wrapped_write_is_a_barrier(setup):
+    """Options() can wrap a write; Counts after it must observe the write
+    (the barrier walks descendants, not just top-level names)."""
+    _, ex = setup
+    col = 8765
+    res = ex.execute(
+        "i",
+        f"Count(Intersect(Row(f=0), Row(f=1))) "
+        f"Options(Set({col}, f=0), excludeColumns=false) "
+        f"Options(Set({col}, f=1), excludeColumns=false) "
+        f"Count(Intersect(Row(f=0), Row(f=1))) "
+        f"Count(Intersect(Row(f=2), Row(f=3)))",
+    )
+    pre01, _, _, post01, _ = res
+    assert post01 == pre01 + 1
+
+
 def test_shards_argument_respected(setup):
     _, ex = setup
     q = _pairs_query([(0, 1), (2, 3)])

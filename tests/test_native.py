@@ -79,6 +79,28 @@ def test_corrupt_oplog_truncates_same_as_python():
     assert len(data) > good_len
 
 
+def test_hostile_oplog_length_no_overflow():
+    """A kOpAddRoaring record claiming a ~2^64-byte payload must not wrap
+    the bounds check and read off the buffer (segfault on hostile fragment
+    bytes via /internal/fragment/data)."""
+    import struct
+
+    base = roaring._serialize_py(np.array([1, 2, 3], dtype=np.uint64))
+    for op_byte in (roaring.OP_ADD_ROARING, roaring.OP_REMOVE_ROARING):
+        for length in (2**64 - 1, 2**64 - 4, 2**64 - 17, 2**63):
+            data = bytes(base) + struct.pack(
+                "<BQI", op_byte, length, 0xDEADBEEF
+            ) + b"\x00\x00\x00\x00"
+            got, _ = _native.deserialize(data)
+            assert got.tolist() == [1, 2, 3]
+    # batch ops: value*8 wrapping must be rejected too
+    for op_byte in (roaring.OP_ADD_BATCH, roaring.OP_REMOVE_BATCH):
+        for length in (2**61, 2**64 - 1):
+            data = bytes(base) + struct.pack("<BQI", op_byte, length, 0)
+            got, _ = _native.deserialize(data)
+            assert got.tolist() == [1, 2, 3]
+
+
 def test_official_format_parse():
     # Build an official-spec file via the existing python test helper path:
     # reuse roaring's serializer for positions in pilosa format, then
