@@ -32,6 +32,7 @@ reference amortizes fsyncs.
 from __future__ import annotations
 
 import threading
+import weakref
 from contextlib import contextmanager
 from typing import Iterable
 
@@ -40,6 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pilosa_tpu.core import membudget
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WORDS
 
@@ -95,6 +97,12 @@ class Fragment:
         # (reference fragment.go:453 storage.OpWriter). Lock order is
         # always fragment._lock (outer) -> store lock (inner).
         self.store = None
+        # HBM accounting key for the device copy (syswrap analogue,
+        # membudget); created lazily on first device sync.
+        self._budget_key = None
+        # set by the budget's evict callback when it could not take the
+        # lock; honored at the next device sync
+        self._evict_pending = False
 
     # -- row bookkeeping ----------------------------------------------------
 
@@ -120,7 +128,7 @@ class Fragment:
             grown = np.zeros((cap, self.n_words), dtype=np.uint32)
             grown[: self.capacity] = self._host
             self._host = grown
-            self._device = None  # full re-upload on next query
+            self._drop_device()  # full re-upload on next query
 
     def _slot(self, row: int, create: bool = False) -> int | None:
         s = self._slot_of.get(row)
@@ -132,6 +140,14 @@ class Fragment:
             if self._counts is not None:
                 self._counts = None
         return s
+
+    def _drop_device(self) -> None:
+        """Drop the device copy and its budget accounting (caller holds
+        the lock); host mirror stays authoritative."""
+        self._device = None
+        self._dirty.clear()
+        if self._budget_key is not None:
+            membudget.default_budget().release(self._budget_key)
 
     # -- mutation -----------------------------------------------------------
 
@@ -261,7 +277,12 @@ class Fragment:
 
     def import_bits(self, rows: np.ndarray, cols: np.ndarray, clear: bool = False) -> int:
         """Bulk import of (row, col-offset) pairs (reference
-        fragment.go:1995-2106 bulkImport). Returns changed-bit count."""
+        fragment.go:1995-2106 bulkImport). Returns changed-bit count.
+
+        The whole batch is applied as ONE vectorized masked update against
+        the host mirror (the role of the reference's container-level merge,
+        roaring.go:1463 ImportRoaringBits) — per-row Python work is limited
+        to slot bookkeeping and op-log records for rows that changed."""
         rows = np.asarray(rows, dtype=np.uint64)
         cols = np.asarray(cols, dtype=np.int64)
         if rows.size == 0:
@@ -276,13 +297,47 @@ class Fragment:
                 (inverse, (cols >> 5).astype(np.int64)),
                 np.uint32(1) << (cols & 31).astype(np.uint32),
             )
-            changed = 0
-            for rid, wrow in zip(row_ids, words):
-                if clear:
-                    changed += self.difference_row_words(int(rid), wrow)
-                else:
-                    changed += self.union_row_words(int(rid), wrow)
-            return changed
+            if clear:
+                keep = np.array(
+                    [int(r) in self._slot_of for r in row_ids], dtype=bool
+                )
+                row_ids, words = row_ids[keep], words[keep]
+                if not len(row_ids):
+                    return 0
+                slots = np.array(
+                    [self._slot_of[int(r)] for r in row_ids], dtype=np.int64
+                )
+            else:
+                for r in row_ids:
+                    self._check_persistable(int(r))
+                slots = np.array(
+                    [self._slot(int(r), create=True) for r in row_ids],
+                    dtype=np.int64,
+                )
+            sub = self._host[slots]
+            if clear:
+                mask = words & sub
+                self._host[slots] = sub & ~words
+            else:
+                mask = words & ~sub
+                self._host[slots] = sub | words
+            per_row = np.bitwise_count(mask).sum(axis=1, dtype=np.int64)
+            changed_idx = np.nonzero(per_row)[0]
+            for i in changed_idx:
+                s = int(slots[i])
+                self._dirty.add(s)
+                if self.store is not None:
+                    if clear:
+                        self.store.log_remove_mask(int(row_ids[i]), mask[i])
+                    else:
+                        self.store.log_add_mask(int(row_ids[i]), mask[i])
+            if len(changed_idx):
+                self._counts = None
+                self.version += 1
+                self.op_n += len(changed_idx)
+                if self.on_op is not None:
+                    self.on_op(self)
+            return int(per_row.sum())
 
     def set_mutex(self, row: int, col: int) -> bool:
         """Mutex-field write: clear col in every other row, set (row, col)
@@ -304,15 +359,68 @@ class Fragment:
 
     # -- device sync & query views -----------------------------------------
 
+    def _device_nbytes(self) -> int:
+        return (self.capacity + 1) * self.n_words * 4
+
+    def device_declined(self) -> bool:
+        """True when this fragment's full device copy alone would exceed
+        the HBM budget cap — callers page rows from the host mirror
+        instead of materializing it (the reference's mmap→file fallback,
+        syswrap/mmap.go)."""
+        return membudget.default_budget().would_decline(self._device_nbytes())
+
+    def _budget_evict_cb(self):
+        ref = weakref.ref(self)
+
+        def cb():
+            f = ref()
+            if f is None:
+                return
+            # NON-BLOCKING acquire: the evicting thread may hold another
+            # fragment's lock (its own admit), and that fragment's evict
+            # callback may want ours — blocking here is an AB-BA deadlock
+            # between two fragments under concurrent serving threads.
+            # When contended, defer: the owner drops its copy at the next
+            # device sync (accounting was already removed by the budget).
+            if f._lock.acquire(blocking=False):
+                try:
+                    f._device = None
+                    f._dirty.clear()
+                finally:
+                    f._lock.release()
+            else:
+                f._evict_pending = True
+
+        return cb
+
+    def _account_device(self, rebuilt: bool) -> None:
+        """Register/refresh the device copy with the process HBM budget
+        (called under self._lock; budget lock nests inside)."""
+        budget = membudget.default_budget()
+        if self._budget_key is None:
+            self._budget_key = membudget.register_owner(self, budget)
+        if rebuilt:
+            budget.admit(
+                self._budget_key, self._device_nbytes(), self._budget_evict_cb()
+            )
+        else:
+            budget.touch(self._budget_key)
+
     def device_bits(self) -> jax.Array:
         """The compute copy ``uint32[capacity+1, W]``; final row is zeros.
         Syncs pending host mutations to HBM first."""
         with self._lock:
+            if self._evict_pending:
+                self._evict_pending = False
+                self._device = None
+                self._dirty.clear()
+            rebuilt = False
             if self._device is None or self._device.shape[0] != self.capacity + 1:
                 padded = np.zeros((self.capacity + 1, self.n_words), dtype=np.uint32)
                 padded[: self.capacity] = self._host
                 self._device = jnp.asarray(padded)
                 self._dirty.clear()
+                rebuilt = True
             elif self._dirty:
                 if len(self._dirty) > max(8, self.capacity // 2):
                     padded = np.zeros(
@@ -336,20 +444,35 @@ class Fragment:
                         jnp.asarray(self._host[padded_slots]),
                     )
                 self._dirty.clear()
+            self._account_device(rebuilt)
             return self._device
 
     def row_device(self, row: int) -> jax.Array:
         """One row's words on device; zeros when the row doesn't exist
-        (reference fragment.go:599 ``row`` via roaring OffsetRange)."""
+        (reference fragment.go:599 ``row`` via roaring OffsetRange).
+
+        When the whole fragment exceeds the HBM budget, only the one
+        requested row is shipped (row paging)."""
         with self._lock:
+            if self.device_declined():
+                return jnp.asarray(self.row_words_host(row))
             bits = self.device_bits()
             s = self._slot_of.get(row, self.capacity)
         return bits[s]
 
     def rows_device(self, rows: Iterable[int]) -> jax.Array:
         """Gather many rows -> ``uint32[n, W]``; missing rows gather the
-        zero row."""
+        zero row.  Pages just the requested rows when the fragment
+        exceeds the HBM budget."""
+        rows = list(rows)
         with self._lock:
+            if self.device_declined():
+                out = np.zeros((len(rows), self.n_words), dtype=np.uint32)
+                for i, r in enumerate(rows):
+                    s = self._slot_of.get(r)
+                    if s is not None:
+                        out[i] = self._host[s]
+                return jnp.asarray(out)
             bits = self.device_bits()
             slots = np.array(
                 [self._slot_of.get(r, self.capacity) for r in rows], dtype=np.int32
@@ -377,12 +500,19 @@ class Fragment:
     def row_counts(self) -> tuple[list[int], np.ndarray]:
         """(row_ids, per-row popcounts) over existing rows — the TopN
         ranked-cache analogue (reference cache.go; recounted like
-        fragment.go:459-498 but vectorized on device)."""
+        fragment.go:459-498 but vectorized on device; host popcount when
+        the fragment exceeds the HBM budget)."""
         with self._lock:
             if self._counts is None or len(self._counts) != len(self._rowids):
-                bits = self.device_bits()
-                counts = np.asarray(bitops.count_rows(bits))
-                self._counts = counts[: len(self._rowids)]
+                if self.device_declined():
+                    self._counts = (
+                        np.bitwise_count(self._host)
+                        .sum(axis=1, dtype=np.int64)[: len(self._rowids)]
+                    )
+                else:
+                    bits = self.device_bits()
+                    counts = np.asarray(bitops.count_rows(bits))
+                    self._counts = counts[: len(self._rowids)]
             ids = list(self._rowids)
             return ids, self._counts.copy()
 
@@ -486,8 +616,7 @@ class Fragment:
             self._slot_of.clear()
             self._rowids.clear()
             self._host = np.zeros((0, self.n_words), dtype=np.uint32)
-            self._device = None
-            self._dirty.clear()
+            self._drop_device()
             self._counts = None
             self.version += 1
             for row in sorted(rows):

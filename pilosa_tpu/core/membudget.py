@@ -1,0 +1,127 @@
+"""Device-memory budget: LRU accounting for HBM-resident copies.
+
+The reference caps mmap count / open files and raises rlimits so a holder
+with more fragments than the OS allows still serves (reference
+syswrap/mmap.go — 60k map cap with file fallback; holder.go:43,551-597).
+The TPU analogue is HBM: every fragment device copy and every executor
+field stack is registered here, and when the budget cap is exceeded the
+least-recently-used entries are evicted back to their host mirrors (the
+"file fallback").  Device memory is per-process, not per-Holder, so the
+default budget is a process-wide singleton; tests or embedders can
+configure a small cap to exercise eviction.
+
+Deadlock discipline: evict callbacks are invoked AFTER the budget lock is
+released (victims are collected under the lock, called outside it), so a
+callback may take its owner's lock while the admit path holds
+owner-lock -> budget-lock — the two orders never nest.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Callable
+
+
+class DeviceBudget:
+    """Tracks device-resident bytes per owner key with LRU eviction."""
+
+    def __init__(self, cap_bytes: int | None = None):
+        self.cap = cap_bytes  # None = unlimited (accounting only)
+        self._lock = threading.Lock()
+        # key -> (nbytes, evict_callback); insertion order = LRU order
+        self._entries: "OrderedDict[object, tuple[int, Callable[[], None]]]" = (
+            OrderedDict()
+        )
+        self._used = 0
+        # counters for stats/diagnostics
+        self.evictions = 0
+        self.admissions = 0
+
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def would_decline(self, nbytes: int) -> bool:
+        """True when a single allocation of ``nbytes`` exceeds the whole
+        cap — callers should prefer a paged strategy over admitting it."""
+        return self.cap is not None and nbytes > self.cap
+
+    def admit(self, key, nbytes: int, evict: Callable[[], None]) -> None:
+        """Account ``nbytes`` of device memory for ``key`` (replacing any
+        previous entry), evicting least-recently-used OTHER entries until
+        the cap is met.  An entry larger than the entire cap is still
+        admitted after evicting everything else — the caller already
+        holds the array; callers that can page should check
+        ``would_decline`` first."""
+        victims: list[Callable[[], None]] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._used -= old[0]
+            if self.cap is not None:
+                while self._used + nbytes > self.cap and self._entries:
+                    _, (vbytes, vcb) = self._entries.popitem(last=False)
+                    self._used -= vbytes
+                    self.evictions += 1
+                    victims.append(vcb)
+            self._entries[key] = (nbytes, evict)
+            self._used += nbytes
+            self.admissions += 1
+        for cb in victims:
+            try:
+                cb()
+            except Exception:
+                pass  # eviction is advisory; owner may already be gone
+
+    def touch(self, key) -> None:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    def release(self, key) -> None:
+        """Remove an entry WITHOUT invoking its evict callback (the owner
+        dropped its device copy itself, or died)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._used -= old[0]
+
+
+_default: DeviceBudget | None = None
+_default_lock = threading.Lock()
+
+
+def default_budget() -> DeviceBudget:
+    """The process-wide budget.  Cap comes from PILOSA_TPU_HBM_BUDGET_BYTES
+    (unset/0 = unlimited accounting)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            cap = int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", "0")) or None
+            _default = DeviceBudget(cap)
+        return _default
+
+
+def configure(cap_bytes: int | None) -> DeviceBudget:
+    """Install a fresh process-wide budget with the given cap (existing
+    entries are forgotten, not evicted — their owners re-admit on next
+    device sync)."""
+    global _default
+    with _default_lock:
+        _default = DeviceBudget(cap_bytes)
+        return _default
+
+
+def register_owner(key_obj, budget: DeviceBudget) -> object:
+    """A stable budget key for ``key_obj`` that auto-releases its entry
+    when the owner is garbage collected."""
+    key = id(key_obj)
+    weakref.finalize(key_obj, budget.release, key)
+    return key

@@ -14,6 +14,8 @@ Union/Xor/Not/Shift (executor.go:653-680)."""
 
 from __future__ import annotations
 
+import threading
+import weakref
 from datetime import datetime
 from typing import Any
 
@@ -23,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu import pql
-from pilosa_tpu.core import timequantum
+from pilosa_tpu.core import membudget, timequantum
 from pilosa_tpu.obs import tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
@@ -102,6 +104,10 @@ class Executor:
     def __init__(self, holder: Holder, translator: TranslateStore | None = None):
         self.holder = holder
         self.translator = translator or TranslateStore()
+        # stack maintenance accounting (tested: incremental refresh must
+        # replace full re-uploads on write-interleaved workloads)
+        self.stack_rebuilds = 0
+        self.stack_incremental = 0
 
     # ------------------------------------------------------------------ API
 
@@ -169,6 +175,10 @@ class Executor:
             return None
         return fname, op, rows[0], rows[1]
 
+    # stacks kept per (mesh, shard set); two entries so alternating shard
+    # arguments don't evict each other every call
+    _STACK_CACHE_ENTRIES = 2
+
     def _field_stack(self, field: Field, shards: list[int]):
         """(slot_of, bits[S, R, W] device tensor) for the field's standard
         view, DENSE over ``shards`` (all-zero slices where a shard has no
@@ -178,9 +188,14 @@ class Executor:
         mesh — NamedSharding(mesh, P("shards")) with the shard axis
         padded to the mesh size — so every batched kernel runs on all
         chips (the reference's shard→node mapReduce, executor.go:2454,
-        as a static placement). Cached on the field; invalidated by any
-        fragment mutation (version counters) or membership change in
-        ``shards``. None when over budget or empty."""
+        as a static placement).
+
+        Maintenance is INCREMENTAL: when cached fragment versions drift
+        but the row set is unchanged, only the changed shards' row blocks
+        are scattered into the device stack (one launch) instead of
+        re-uploading the whole field — the write-batch analogue of the
+        reference applying ops to an mmap'd fragment in place
+        (fragment.go:2284-2293). None when over budget or empty."""
         from jax.sharding import NamedSharding, PartitionSpec
         from pilosa_tpu.parallel.mesh import serving_mesh
 
@@ -191,38 +206,123 @@ class Executor:
         mesh = serving_mesh()
         # The mesh is part of the key: a device-set/configure_serving
         # change must invalidate stacks built with the old sharding.
-        key = (
-            mesh,
-            tuple(shards),
-            tuple(frags[s].version if s in frags else -1 for s in shards),
+        cache_key = (mesh, tuple(shards))
+        versions = tuple(
+            frags[s].version if s in frags else -1 for s in shards
         )
-        cache = getattr(field, "_stack_cache", None)
-        if cache is not None and cache[0] == key:
-            return cache[1], cache[2]
-        row_ids = sorted({r for f in frags.values() for r in f.row_ids()})
-        if not row_ids:
+        budget = membudget.default_budget()
+        # Per-FIELD lock (fields are shared between executors wrapping the
+        # same holder); setdefault on the instance dict is atomic.
+        lock = vars(field).setdefault("_stack_lock", threading.RLock())
+        with lock:
+            caches = vars(field).setdefault("_stack_caches", {})
+            entry = caches.get(cache_key)
+            if entry is not None:
+                if entry["versions"] == versions:
+                    budget.touch(entry["bkey"])
+                    return entry["slot_of"], entry["dev"]
+                updated = self._stack_incremental_update(
+                    field, entry, frags, shards, versions
+                )
+                if updated is not None:
+                    budget.touch(entry["bkey"])
+                    return updated
+                caches.pop(cache_key, None)
+                budget.release(entry["bkey"])
+
+            row_ids = sorted({r for f in frags.values() for r in f.row_ids()})
+            if not row_ids:
+                return None
+            S, R, W = len(shards), len(row_ids), field.n_words
+            if mesh is not None:
+                n_dev = mesh.devices.size
+                S = -(-S // n_dev) * n_dev  # pad so the mesh divides the axis
+            nbytes = S * R * W * 4
+            if nbytes > _STACK_BUDGET_BYTES or budget.would_decline(nbytes):
+                # over HBM budget: callers fall back to per-fragment paths,
+                # which page rows under the same budget (membudget)
+                return None
+            slot_of = {r: i for i, r in enumerate(row_ids)}
+            bits = np.zeros((S, R, W), dtype=np.uint32)
+            for si, s in enumerate(shards):
+                f = frags.get(s)
+                if f is None:
+                    continue
+                for r in f.row_ids():
+                    bits[si, slot_of[r]] = f.row_words_host(r)
+            if mesh is not None:
+                dev = jax.device_put(
+                    bits,
+                    NamedSharding(mesh, PartitionSpec("shards", None, None)),
+                )
+            else:
+                dev = jnp.asarray(bits)
+            self.stack_rebuilds += 1
+            while len(caches) >= self._STACK_CACHE_ENTRIES:
+                old = caches.pop(next(iter(caches)))  # oldest entry first
+                budget.release(old["bkey"])
+            # Each cache entry carries its OWN budget key (two stacks per
+            # field may be live; one shared key would undercount) and is
+            # released whenever the entry is dropped.
+            bkey = object()
+            weakref.finalize(field, budget.release, bkey)
+            entry = {
+                "versions": versions,
+                "slot_of": slot_of,
+                "dev": dev,
+                "bkey": bkey,
+            }
+            caches[cache_key] = entry
+
+            def _evict(fref=weakref.ref(field), ck=cache_key):
+                f = fref()
+                if f is not None:
+                    # lock-free atomic pop: the evicting thread may hold a
+                    # different field's stack lock (AB-BA risk); a reader
+                    # holding a reference to the popped entry just keeps
+                    # using its (still-valid) device array
+                    getattr(f, "_stack_caches", {}).pop(ck, None)
+
+            budget.admit(bkey, nbytes, _evict)
+            return slot_of, dev
+
+    # incremental refresh only pays when few shards changed; past this
+    # fraction a single bulk re-upload wins
+    _STACK_INCR_MAX_FRACTION = 0.5
+
+    def _stack_incremental_update(
+        self, field: Field, entry: dict, frags, shards: list[int], versions
+    ):
+        """Refresh changed shards of a cached stack in one device scatter;
+        None when a full rebuild is needed (row set grew, or too many
+        shards drifted)."""
+        slot_of = entry["slot_of"]
+        changed = [
+            si for si, (a, b) in enumerate(zip(entry["versions"], versions))
+            if a != b
+        ]
+        if not changed or len(changed) > max(
+            1, int(len(shards) * self._STACK_INCR_MAX_FRACTION)
+        ):
             return None
-        S, R, W = len(shards), len(row_ids), field.n_words
-        if mesh is not None:
-            n_dev = mesh.devices.size
-            S = -(-S // n_dev) * n_dev  # pad so the mesh divides the axis
-        if S * R * W * 4 > _STACK_BUDGET_BYTES:
-            return None
-        slot_of = {r: i for i, r in enumerate(row_ids)}
-        bits = np.zeros((S, R, W), dtype=np.uint32)
-        for si, s in enumerate(shards):
-            f = frags.get(s)
+        R = len(slot_of)
+        W = field.n_words
+        blocks = np.zeros((len(changed), R, W), dtype=np.uint32)
+        for k, si in enumerate(changed):
+            f = frags.get(shards[si])
             if f is None:
-                continue
+                return None
             for r in f.row_ids():
-                bits[si, slot_of[r]] = f.row_words_host(r)
-        if mesh is not None:
-            dev = jax.device_put(
-                bits, NamedSharding(mesh, PartitionSpec("shards", None, None))
-            )
-        else:
-            dev = jnp.asarray(bits)
-        field._stack_cache = (key, slot_of, dev)
+                slot = slot_of.get(r)
+                if slot is None:
+                    return None  # new row: shape change, full rebuild
+                blocks[k, slot] = f.row_words_host(r)
+        dev = entry["dev"].at[jnp.asarray(changed, jnp.int32)].set(
+            jnp.asarray(blocks)
+        )
+        entry["dev"] = dev  # dev before versions: a racing reader keyed on
+        entry["versions"] = versions  # versions must never see the old dev
+        self.stack_incremental += 1
         return slot_of, dev
 
     def _batch_pair_counts(
@@ -994,19 +1094,36 @@ class Executor:
         counts: dict[int, int] = {}
         src_count = src.count() if src is not None else 0
         row_totals: dict[int, int] = {}
-        if view is not None and src is None:
-            # No source filter: one row-scan launch over the cached field
-            # stack answers every shard at once (ops/kernels.py row_counts,
-            # replacing the reference's per-fragment cache merge).
+        if view is not None:
+            # One launch over the cached field stack answers every
+            # (shard, row) at once — unfiltered via the row-scan kernel,
+            # filtered via the masked-count kernel (replacing the
+            # reference's per-fragment cache merge and the per-shard
+            # filter loop, fragment.go:1586-1655).
             stack = self._field_stack(field, shards)
             if stack is not None:
                 from pilosa_tpu.ops import kernels
 
                 slot_of, bits = stack
-                rc = np.asarray(kernels.row_counts(bits)).astype(np.int64)
-                for rid, slot in slot_of.items():
-                    if rc[slot]:
-                        counts[rid] = int(rc[slot])
+                if src is None:
+                    rc = np.asarray(kernels.row_counts(bits)).astype(np.int64)
+                    for rid, slot in slot_of.items():
+                        if rc[slot]:
+                            counts[rid] = int(rc[slot])
+                else:
+                    S, _, W = bits.shape
+                    filt = self._row_to_shard_matrix(src, shards, S, W)
+                    mc = kernels.masked_row_counts(bits, filt)
+                    for rid, slot in slot_of.items():
+                        if mc[slot]:
+                            counts[rid] = int(mc[slot])
+                    if has_tanimoto:
+                        rc = np.asarray(kernels.row_counts(bits)).astype(
+                            np.int64
+                        )
+                        for rid, slot in slot_of.items():
+                            if rc[slot]:
+                                row_totals[rid] = int(rc[slot])
                 view = None  # stack covered every shard; skip the loop
         if view is not None:
             for shard in shards:
@@ -1180,16 +1297,20 @@ class Executor:
         results: list[GroupCount] = []
         use_limit = has_limit and limit > 0
 
-        # Two-level cross-field fast path: all combination counts in one
-        # batched device launch over aligned field stacks (reference runs
-        # one intersectionCount per combo, executor.go:3208-3211).
-        if (
-            len(levels) == 2
-            and filt_row is None
-            and not has_prev
-            and all(f.view(VIEW_STANDARD) is not None for _, f, _ in levels)
+        if not has_prev and all(
+            f.view(VIEW_STANDARD) is not None for _, f, _ in levels
         ):
-            fast = self._groupby_two_level_batch(idx, levels, shards)
+            fast = None
+            if len(levels) == 2 and filt_row is None:
+                # Two-level fast path: the pair-count kernel needs no
+                # prefix masks at all (reference executor.go:3208-3211).
+                fast = self._groupby_two_level_batch(idx, levels, shards)
+            elif len(levels) >= 2:
+                # k-level: one batched intersect-count launch per level
+                # over running prefix masks, pruning empty combos.
+                fast = self._groupby_k_level_batch(
+                    idx, levels, shards, filt_row
+                )
             if fast is not None:
                 return fast[: limit if use_limit else len(fast)]
 
@@ -1302,6 +1423,103 @@ class Executor:
                     )
                 )
         return out
+
+    @staticmethod
+    def _row_to_shard_matrix(row: Row, shards: list[int], S: int, W: int) -> np.ndarray:
+        """A Row's per-shard segments as a dense ``uint32[S, W]`` matrix
+        aligned to a stack's (padded) shard axis; absent shards are
+        zero."""
+        filt = np.zeros((S, W), dtype=np.uint32)
+        for si, s in enumerate(shards):
+            seg = row.segments.get(s)
+            if seg is not None:
+                filt[si] = np.asarray(seg)
+        return filt
+
+    # prefix-mask memory ceiling for the k-level GroupBy batch
+    _GROUPBY_PREFIX_BUDGET_BYTES = 256 << 20
+
+    def _groupby_k_level_batch(
+        self, idx: Index, levels, shards: list[int], filt_row
+    ) -> list[GroupCount] | None:
+        """All k-level combination counts with O(1) launches per level:
+        maintain [C, S, W] intersection masks for surviving combos, count
+        every (combo, next-row) pair in one scan launch, prune zeros,
+        refine. None when stacks are unavailable or the surviving combo
+        set would exceed the prefix budget (callers fall back to the
+        recursive path). Matches reference semantics executor.go:3057-3230
+        (DFS row order, count = intersection of all levels + filter)."""
+        from pilosa_tpu.ops import kernels
+
+        stacks = []
+        for _, f, _ in levels:
+            st = self._field_stack(f, shards)
+            if st is None:
+                return None
+            stacks.append(st)
+        slot0, bits0 = stacks[0]
+        S, _, W = bits0.shape
+        cmax = max(1, self._GROUPBY_PREFIX_BUDGET_BYTES // (S * W * 4))
+
+        rows1 = [r for r in levels[0][2] if r in slot0]
+        if not rows1:
+            return []
+        if len(rows1) > cmax or len(rows1) > self._GROUPBY_BATCH_MAX:
+            return None
+        prefix = kernels.gather_prefix(
+            bits0, jnp.asarray([slot0[r] for r in rows1], jnp.int32)
+        )
+        if filt_row is not None:
+            filt = self._row_to_shard_matrix(filt_row, shards, S, W)
+            prefix = prefix & jnp.asarray(filt)[None]
+        combos: list[tuple[int, ...]] = [(r,) for r in rows1]
+
+        with tracing.start_span("executor.groupByKLevel").set_tag(
+            "levels", len(levels)
+        ):
+            for li in range(1, len(levels)):
+                slotL, bitsL = stacks[li]
+                rows = [r for r in levels[li][2] if r in slotL]
+                if not rows:
+                    return []
+                idxL = jnp.asarray([slotL[r] for r in rows], jnp.int32)
+                counts = np.asarray(
+                    kernels.combo_counts(prefix, bitsL, idxL)
+                ).astype(np.int64).sum(axis=2)  # [C, Rl]
+                live = np.argwhere(counts > 0)  # row-major: DFS order
+                if li == len(levels) - 1:
+                    out = []
+                    for ci, ri in live:
+                        out.append(
+                            GroupCount(
+                                group=[
+                                    FieldRow(
+                                        field=levels[k][0], row_id=rid
+                                    )
+                                    for k, rid in enumerate(
+                                        combos[ci] + (rows[ri],)
+                                    )
+                                ],
+                                count=int(counts[ci, ri]),
+                            )
+                        )
+                    return out
+                if len(live) == 0:
+                    return []
+                if len(live) > cmax or len(live) > self._GROUPBY_BATCH_MAX:
+                    return None
+                prefix = kernels.refine_prefix(
+                    prefix,
+                    bitsL,
+                    jnp.asarray(live[:, 0], jnp.int32),
+                    jnp.asarray(
+                        [slotL[rows[ri]] for ri in live[:, 1]], jnp.int32
+                    ),
+                )
+                combos = [
+                    combos[ci] + (rows[ri],) for ci, ri in live
+                ]
+        return []
 
     # --------------------------------------------------------------- Options
 

@@ -510,6 +510,144 @@ def row_counts_per_shard_xla(bits: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=2)
 
 
+# ---------------------------------------------------------------------------
+# GroupBy combo kernels: iterated batched intersect-counts over a running
+# set of prefix masks (reference executor.go:3057-3230 runs one
+# intersectionCount per combination; here one launch per LEVEL).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def combo_counts(prefix: jax.Array, bits: jax.Array, idx: jax.Array) -> jax.Array:
+    """``int32[C, Rl, S]`` per-shard counts of every (prefix combo, row)
+    intersection: popcount(prefix[c] & bits[:, idx[r]]).  A scan over the
+    level's rows keeps peak memory at one [C, S, W] intermediate."""
+
+    def body(_, r):
+        rowsl = bits[:, r]  # [S, W]
+        return None, jnp.sum(
+            lax.population_count(prefix & rowsl[None]).astype(jnp.int32),
+            axis=-1,
+        )  # [C, S]
+
+    _, out = lax.scan(body, None, idx)  # [Rl, C, S]
+    return jnp.transpose(out, (1, 0, 2))
+
+
+@jax.jit
+def refine_prefix(
+    prefix: jax.Array, bits: jax.Array, cis: jax.Array, ris: jax.Array
+) -> jax.Array:
+    """Next level's surviving prefix masks:
+    ``prefix[cis[i]] & bits[:, ris[i]]`` -> [C', S, W]."""
+    return prefix[cis] & jnp.transpose(bits[:, ris], (1, 0, 2))
+
+
+@jax.jit
+def gather_prefix(bits: jax.Array, idx: jax.Array) -> jax.Array:
+    """Level-0 prefix masks: rows of a stack as [C, S, W]."""
+    return jnp.transpose(bits[:, idx], (1, 0, 2))
+
+
+# ---------------------------------------------------------------------------
+# Masked row-scan: counts[s, r] = sum_w popcount(bits[s, r, w] & filt[s, w])
+# (filtered TopN: every row intersected with a source bitmap in one launch)
+# ---------------------------------------------------------------------------
+
+
+def _masked_row_counts_kernel(bits_ref, filt_ref, out_ref):
+    w = pl.program_id(2)
+    words = bits_ref[0] & filt_ref[0][None, :]
+    pc = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=-1)
+
+    @pl.when(w == 0)
+    def _():
+        out_ref[0, :] = pc
+
+    @pl.when(w != 0)
+    def _():
+        out_ref[0, :] = out_ref[0, :] + pc
+
+
+@jax.jit
+def masked_row_counts_pallas(bits: jax.Array, filt: jax.Array) -> jax.Array:
+    """``int32[S, R]`` per-shard popcounts of every row ANDed with a
+    per-shard filter bitmap — the one-launch replacement for the
+    per-shard host loop in filtered TopN (reference fragment.go:1586-1655
+    topWithFilter)."""
+    S, R, W = bits.shape
+    rb = _ROW_BLOCK
+    pad = (-R) % rb
+    if pad:
+        bits = jnp.pad(bits, ((0, 0), (0, pad), (0, 0)))
+    Rp = R + pad
+    wb = _word_block(W)
+    out = pl.pallas_call(
+        _masked_row_counts_kernel,
+        grid=(Rp // rb, S, W // wb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, rb, wb),
+                lambda r, s, w: (s, r, w),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, wb),
+                lambda r, s, w: (s, w),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rb),
+            lambda r, s, w: (s, r),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, Rp), jnp.int32),
+        interpret=_interpret(),
+    )(bits, filt)
+    return out[:, :R]
+
+
+@jax.jit
+def masked_row_counts_xla(bits: jax.Array, filt: jax.Array) -> jax.Array:
+    return jnp.sum(
+        lax.population_count(bits & filt[:, None, :]).astype(jnp.int32), axis=2
+    )
+
+
+@lru_cache(maxsize=64)
+def _masked_row_counts_sharded_fn(mesh, axis, use_pallas):
+    local = masked_row_counts_pallas if use_pallas else masked_row_counts_xla
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None)),
+            out_specs=P(axis, None),
+        )
+    )
+
+
+def masked_row_counts(bits: jax.Array, filt: jax.Array):
+    """``int64[R]`` numpy: per-row popcount of (row & filter) summed over
+    shards.  One launch for every (shard, row) — kills the per-shard
+    dispatch loop of filtered TopN."""
+    m = shards_axis_of(bits)
+    if m is not None:
+        mesh, axis = m
+        fspec = NamedSharding(mesh, P(axis, None))
+        if getattr(filt, "sharding", None) != fspec:
+            filt = jax.device_put(np.asarray(filt), fspec)
+        partials = _run_sharded(
+            _masked_row_counts_sharded_fn, (mesh, axis), (bits, filt)
+        )
+    else:
+        partials = _try_pallas(
+            masked_row_counts_pallas, masked_row_counts_xla, bits, filt
+        )
+    return np.asarray(partials).astype(np.int64).sum(axis=0)
+
+
 def _int32_safe(bits) -> bool:
     """Cross-shard per-row totals fit int32 when S * shard_bits < 2^31."""
     S, _, W = bits.shape

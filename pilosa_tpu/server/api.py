@@ -91,6 +91,12 @@ class API:
         # Diagnostics collector; NodeServer installs one (reference
         # server.go diagnostics wiring).
         self.diagnostics = None
+        # Bounded import worker pool: concurrency limit + backpressure
+        # (reference api.go:66-96 importWorkerPoolSize=2, importWorker
+        # :313-348).
+        from pilosa_tpu.server.importpool import ImportPool
+
+        self.import_pool = ImportPool(workers=2, depth=16)
 
     @property
     def state(self) -> str:
@@ -323,7 +329,14 @@ class API:
 
         if not req.get("remote") and self._route_import(index, f, req, cols):
             return
+        # The local apply runs on the import worker pool (bounded queue,
+        # reference api.go:313-348); the handler blocks on completion.
+        self.import_pool.run(
+            lambda: self._apply_import(idx, f, index, field, req, cols)
+        )
 
+    def _apply_import(self, idx, f, index: str, field: str, req: dict, cols) -> None:
+        translator = self.executor.translator
         if "values" in req:
             if not f.is_bsi():
                 raise ApiError(f"field {field!r} is not an int field")
@@ -473,7 +486,9 @@ class API:
                     500,
                 )
             return {"changed": changed}
-        return self._apply_roaring(index, f, shard, data, clear, view)
+        return self.import_pool.run(
+            lambda: self._apply_roaring(index, f, shard, data, clear, view)
+        )
 
     def _apply_roaring(self, index: str, f, shard: int, data: bytes, clear: bool, view: str) -> dict:
         """Local roaring apply, state-gate-free (also the landing path for
@@ -849,5 +864,6 @@ class API:
             self.store.sync()
 
     def close(self) -> None:
+        self.import_pool.close()
         if self.store is not None:
             self.store.close()

@@ -1,0 +1,66 @@
+"""Bounded import worker pool (reference api.go:66-96, importWorker
+:313-348).
+
+The reference queues every import job onto a channel drained by
+``importWorkerPoolSize`` goroutines and the HTTP handler blocks on the
+job's error channel — a concurrency limiter with backpressure, not
+fire-and-forget.  Same shape here: ``run`` submits a job to a bounded
+queue and waits for its result; when the queue is full, submission blocks
+(backpressure to the ingest client).  A job submitted FROM a worker
+thread runs inline instead, so nested imports (the coordinator's local
+slice re-entering the API) can never deadlock the pool.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class ImportPool:
+    def __init__(self, workers: int = 2, depth: int = 16):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._local = threading.local()
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True, name=f"import-{i}")
+            for i in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        self._local.is_worker = True
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, done = item
+            try:
+                done["result"] = fn()
+            except BaseException as e:  # propagate to the submitter
+                done["error"] = e
+            finally:
+                done["event"].set()
+                self._q.task_done()
+
+    def run(self, fn):
+        """Execute ``fn`` on a pool worker and return its result; blocks
+        for queue space (backpressure) and for completion, like the
+        reference handler blocking on the job's error channel
+        (api.go:330-346)."""
+        if self._closed or getattr(self._local, "is_worker", False):
+            return fn()
+        done = {"event": threading.Event()}
+        self._q.put((fn, done))
+        done["event"].wait()
+        if "error" in done:
+            raise done["error"]
+        return done["result"]
+
+    def close(self) -> None:
+        self._closed = True
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)

@@ -18,11 +18,14 @@ def setup():
     ex = Executor(h)
     rng = np.random.default_rng(4)
     writes = []
+    # f and g draw columns from a shared pool so cross-field intersections
+    # (GroupBy combos, filtered TopN) are non-trivial
+    pool = rng.integers(0, 3 * h.n_words * 32, size=120)
     for row in range(6):
-        for col in rng.integers(0, 3 * h.n_words * 32, size=50):
+        for col in rng.choice(pool, size=50, replace=False):
             writes.append(f"Set({int(col)}, f={row})")
     for row in range(3):
-        for col in rng.integers(0, 2 * h.n_words * 32, size=30):
+        for col in rng.choice(pool, size=30, replace=False):
             writes.append(f"Set({int(col)}, g={row})")
     ex.execute("i", " ".join(writes))
     return h, ex
@@ -147,6 +150,53 @@ def test_shards_argument_respected(setup):
     assert only0 == per
 
 
+def test_interleaved_writes_update_stack_incrementally(setup):
+    """A write batch touching one shard must refresh the cached stack via
+    a device scatter of that shard block, not a full host restack
+    (reference applies ops in place, fragment.go:2284-2293)."""
+    h, ex = setup
+    q = _pairs_query([(0, 1), (2, 3)])
+    ex.execute("i", q)  # build + cache the stack
+    rebuilds0 = ex.stack_rebuilds
+    width = h.n_words * 32
+    for i in range(4):
+        # rows 0/1 already exist in shard 0; no new rows => incremental
+        ex.execute("i", f"Set({100 + i}, f=0) Set({100 + i}, f=1)")
+        got = ex.execute("i", q)
+        want = [ex.execute("i", _pairs_query([p]))[0] for p in [(0, 1), (2, 3)]]
+        assert got == want
+    assert ex.stack_incremental >= 4
+    assert ex.stack_rebuilds == rebuilds0  # no full re-upload happened
+
+
+def test_two_shard_sets_keep_separate_cache_entries(setup):
+    """Alternating shards arguments must not evict each other (two cache
+    entries per field)."""
+    _, ex = setup
+    q = _pairs_query([(0, 1), (2, 3)])
+    ex.execute("i", q)
+    ex.execute("i", q, shards=[0])
+    r0 = ex.stack_rebuilds
+    # both entries warm: neither call rebuilds
+    ex.execute("i", q)
+    ex.execute("i", q, shards=[0])
+    ex.execute("i", q)
+    assert ex.stack_rebuilds == r0
+
+
+def test_new_row_forces_full_rebuild(setup):
+    """A write creating a brand-new row changes the stack shape and must
+    fall back to a full rebuild, still answering correctly."""
+    _, ex = setup
+    q = _pairs_query([(0, 1), (2, 3)])
+    ex.execute("i", q)
+    r0 = ex.stack_rebuilds
+    ex.execute("i", "Set(77, f=40)")  # row 40 did not exist
+    got = ex.execute("i", q + " Count(Intersect(Row(f=40), Row(f=40)))")
+    assert got[2] == 1
+    assert ex.stack_rebuilds == r0 + 1
+
+
 def test_groupby_fast_path_matches_recursive(setup):
     _, ex = setup
 
@@ -160,6 +210,12 @@ def test_groupby_fast_path_matches_recursive(setup):
         "GroupBy(Rows(g), Rows(f))",
         "GroupBy(Rows(f), Rows(f))",
         "GroupBy(Rows(f), Rows(g), limit=3)",
+        # k-level + filter shapes (batched prefix-mask engine)
+        "GroupBy(Rows(f), Rows(g), Rows(f))",
+        "GroupBy(Rows(g), Rows(f), Rows(g), Rows(f))",
+        "GroupBy(Rows(f), Rows(g), filter=Row(f=0))",
+        "GroupBy(Rows(f), Rows(g), Rows(f), filter=Row(g=1))",
+        "GroupBy(Rows(f), Rows(g), Rows(f), limit=5)",
     ]
     for q in queries:
         fast = ex.execute("i", q)[0]
@@ -170,3 +226,39 @@ def test_groupby_fast_path_matches_recursive(setup):
         finally:
             ex._GROUPBY_BATCH_MAX = old_max
         assert norm(fast) == norm(slow), q
+        assert norm(fast), q  # non-trivial result
+
+
+def test_filtered_topn_matches_per_fragment(setup):
+    """Filtered TopN must match the per-fragment path bit-for-bit (one
+    masked-count launch vs the old per-shard loop)."""
+    h, ex = setup
+    q = "TopN(f, Row(g=0), n=4)"
+    fast = ex.execute("i", q)[0]
+    # force the per-fragment path by disabling the stack
+    field = h.index("i").field("f")
+    from pilosa_tpu.exec import executor as ex_mod
+
+    old = ex_mod.Executor._field_stack
+    try:
+        ex_mod.Executor._field_stack = lambda self, f, s: None
+        slow = ex.execute("i", q)[0]
+    finally:
+        ex_mod.Executor._field_stack = old
+    assert [(p.id, p.count) for p in fast] == [(p.id, p.count) for p in slow]
+    assert fast  # non-trivial
+
+
+def test_filtered_topn_tanimoto_matches(setup):
+    h, ex = setup
+    q = "TopN(f, Row(g=1), n=6, tanimotoThreshold=5)"
+    fast = ex.execute("i", q)[0]
+    from pilosa_tpu.exec import executor as ex_mod
+
+    old = ex_mod.Executor._field_stack
+    try:
+        ex_mod.Executor._field_stack = lambda self, f, s: None
+        slow = ex.execute("i", q)[0]
+    finally:
+        ex_mod.Executor._field_stack = old
+    assert [(p.id, p.count) for p in fast] == [(p.id, p.count) for p in slow]

@@ -1,0 +1,125 @@
+"""Import worker pool: bounded concurrency + backpressure + nested-job
+inlining (reference api.go:66-96, importWorker :313-348)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.importpool import ImportPool
+
+
+def test_run_returns_result_and_propagates_errors():
+    pool = ImportPool(workers=2, depth=4)
+    try:
+        assert pool.run(lambda: 42) == 42
+        with pytest.raises(ValueError):
+            pool.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    finally:
+        pool.close()
+
+
+def test_jobs_run_on_worker_threads_concurrently():
+    pool = ImportPool(workers=2, depth=8)
+    try:
+        names = []
+        barrier = threading.Barrier(2, timeout=5)
+
+        def job():
+            names.append(threading.current_thread().name)
+            barrier.wait()  # both workers must be in-flight together
+            return True
+
+        threads = [
+            threading.Thread(target=lambda: pool.run(job)) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert all(n.startswith("import-") for n in names)
+        assert len(set(names)) == 2
+    finally:
+        pool.close()
+
+
+def test_nested_submission_runs_inline_no_deadlock():
+    pool = ImportPool(workers=1, depth=1)
+    try:
+        # outer job occupies the only worker; inner run() must inline
+        assert pool.run(lambda: pool.run(lambda: "inner")) == "inner"
+    finally:
+        pool.close()
+
+
+def test_closed_pool_runs_inline():
+    pool = ImportPool(workers=1, depth=1)
+    pool.close()
+    assert pool.run(lambda: 7) == 7
+
+
+def test_api_import_goes_through_pool():
+    api = API()
+    try:
+        api.create_index("i")
+        api.create_field("i", "f")
+        seen = []
+        orig = api.import_pool.run
+
+        def spy(fn):
+            seen.append(threading.current_thread().name)
+            return orig(fn)
+
+        api.import_pool.run = spy
+        api.import_bits(
+            "i", "f", {"rowIDs": [1, 1, 2], "columnIDs": [5, 9, 5]}
+        )
+        assert seen, "import did not submit to the pool"
+        res = api.query("i", "Count(Row(f=1))")
+        assert res["results"][0] == 2
+    finally:
+        api.close()
+
+
+def test_concurrent_api_imports_are_serialized_safely():
+    api = API()
+    try:
+        api.create_index("i")
+        api.create_field("i", "f")
+        rng = np.random.default_rng(3)
+        batches = [
+            {
+                "rowIDs": [int(r) for r in rng.integers(0, 4, size=200)],
+                "columnIDs": [int(c) for c in rng.integers(0, 10000, size=200)],
+            }
+            for _ in range(8)
+        ]
+        errs = []
+
+        def do(b):
+            try:
+                api.import_bits("i", "f", b)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=do, args=(b,)) for b in batches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        want = len(
+            {
+                (r, c)
+                for b in batches
+                for r, c in zip(b["rowIDs"], b["columnIDs"])
+            }
+        )
+        total = 0
+        for row in range(4):
+            total += api.query("i", f"Count(Row(f={row}))")["results"][0]
+        assert total == want
+    finally:
+        api.close()

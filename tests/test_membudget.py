@@ -1,0 +1,161 @@
+"""HBM budget manager: LRU accounting + eviction for device copies
+(the syswrap/mmap-cap analogue, reference syswrap/mmap.go, holder.go:43).
+
+The integration tests configure a tiny process budget, run Count/TopN
+over a holder whose fragments collectively (or individually) exceed it,
+and assert the queries still answer correctly with device residency held
+under the cap — the reference's "more fragments than mmaps" behavior."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import membudget
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+
+
+@pytest.fixture()
+def restore_budget():
+    yield
+    membudget.configure(None)
+
+
+def test_lru_eviction_order():
+    b = membudget.DeviceBudget(100)
+    evicted = []
+    b.admit("a", 40, lambda: evicted.append("a"))
+    b.admit("b", 40, lambda: evicted.append("b"))
+    b.touch("a")  # b is now LRU
+    b.admit("c", 40, lambda: evicted.append("c"))
+    assert evicted == ["b"]
+    assert b.used() == 80
+    b.admit("d", 90, lambda: evicted.append("d"))
+    assert evicted == ["b", "a", "c"]
+    assert b.used() == 90
+
+
+def test_release_does_not_invoke_callback():
+    b = membudget.DeviceBudget(100)
+    evicted = []
+    b.admit("a", 60, lambda: evicted.append("a"))
+    b.release("a")
+    assert b.used() == 0
+    assert evicted == []
+
+
+def test_admit_replaces_existing_entry():
+    b = membudget.DeviceBudget(100)
+    b.admit("a", 60, lambda: None)
+    b.admit("a", 30, lambda: None)
+    assert b.used() == 30
+    assert b.entry_count() == 1
+
+
+def test_oversize_entry_still_admitted_after_evicting_all():
+    b = membudget.DeviceBudget(100)
+    evicted = []
+    b.admit("a", 50, lambda: evicted.append("a"))
+    assert b.would_decline(150)
+    b.admit("big", 150, lambda: evicted.append("big"))
+    assert evicted == ["a"]
+    assert b.used() == 150
+
+
+def test_owner_gc_releases_entry():
+    b = membudget.DeviceBudget(None)
+
+    class Owner:
+        pass
+
+    o = Owner()
+    key = membudget.register_owner(o, b)
+    b.admit(key, 10, lambda: None)
+    assert b.used() == 10
+    del o
+    import gc
+
+    gc.collect()
+    assert b.used() == 0
+
+
+def _build_holder(n_shards=6, n_rows=8, seed=5):
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    ex = Executor(h)
+    rng = np.random.default_rng(seed)
+    width = h.n_words * 32
+    writes = []
+    for row in range(n_rows):
+        for col in rng.integers(0, n_shards * width, size=60):
+            writes.append(f"Set({int(col)}, f={row})")
+    ex.execute("i", " ".join(writes))
+    return h, ex
+
+
+def _truth_pair(h, a, b):
+    v = h.index("i").field("f").view("standard")
+    return sum(
+        int(np.bitwise_count(fr.row_words_host(a) & fr.row_words_host(b)).sum())
+        for fr in v.fragments.values()
+    )
+
+
+def _truth_topn(h, n):
+    v = h.index("i").field("f").view("standard")
+    counts = {}
+    for fr in v.fragments.values():
+        for r in fr.row_ids():
+            c = int(np.bitwise_count(fr.row_words_host(r)).sum())
+            if c:
+                counts[r] = counts.get(r, 0) + c
+    return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
+
+
+def test_queries_complete_under_small_cap(restore_budget):
+    """Fragments collectively exceed the cap: LRU eviction cycles device
+    copies; results stay correct and residency stays capped."""
+    h, ex = _build_holder()
+    # one fragment device copy is ~ (cap+1)*W*4; allow roughly two
+    frag_bytes = 10 * h.n_words * 4
+    budget = membudget.configure(2 * frag_bytes)
+    res = ex.execute(
+        "i",
+        "Count(Intersect(Row(f=0), Row(f=1))) Count(Intersect(Row(f=2), Row(f=3)))",
+    )
+    assert res == [_truth_pair(h, 0, 1), _truth_pair(h, 2, 3)]
+    topn = ex.execute("i", "TopN(f, n=3)")[0]
+    assert [(p.id, p.count) for p in topn] == _truth_topn(h, 3)
+    assert budget.used() <= budget.cap
+    assert budget.evictions > 0
+
+
+def test_single_fragment_larger_than_cap_pages_rows(restore_budget):
+    """BASELINE config-2 shape: one fragment alone exceeds the whole cap;
+    row paging answers Count/TopN from the host mirror without ever
+    admitting the full fragment."""
+    h, ex = _build_holder(n_shards=2, n_rows=16)
+    budget = membudget.configure(3 * h.n_words * 4)  # < one fragment
+    v = h.index("i").field("f").view("standard")
+    assert all(f.device_declined() for f in v.fragments.values())
+    res = ex.execute("i", "Count(Intersect(Row(f=0), Row(f=1)))")
+    assert res == [_truth_pair(h, 0, 1)]
+    topn = ex.execute("i", "TopN(f, n=2)")[0]
+    assert [(p.id, p.count) for p in topn] == _truth_topn(h, 2)
+    # nothing bigger than the cap was ever admitted
+    assert budget.used() <= budget.cap
+
+
+def test_field_stack_respects_budget_and_evicts(restore_budget):
+    h, ex = _build_holder()
+    shards = sorted(h.index("i").available_shards())
+    field = h.index("i").field("f")
+    # generous budget: stack builds and is accounted
+    budget = membudget.configure(64 << 20)
+    stack = ex._field_stack(field, shards)
+    assert stack is not None
+    assert budget.used() > 0
+    # tiny budget: stack declines, cache cleared on next eviction pressure
+    membudget.configure(1024)
+    field._stack_caches = {}
+    assert ex._field_stack(field, shards) is None
