@@ -95,6 +95,7 @@ def cmd_server(args) -> int:
     # "none" keeps the zero-cost nop client.
     metric_cfg = cfg.get("metric", {})
     stats_client = NOP if metric_cfg.get("service", "none") == "none" else MemStatsClient()
+    tls_cfg = cfg.get("tls", {})
     node = NodeServer(
         data_dir=data_dir,
         host=host,
@@ -103,7 +104,23 @@ def cmd_server(args) -> int:
         long_query_time=float(cfg["long-query-time"]),
         stats_client=stats_client,
         metric_poll_interval=float(metric_cfg.get("poll-interval", 10) or 10),
+        tls_cert=args.tls_cert or tls_cfg.get("certificate") or None,
+        tls_key=args.tls_key or tls_cfg.get("key") or None,
+        tls_skip_verify=bool(tls_cfg.get("skip-verify", False)),
     )
+    # tracing exporter + sampler (reference tracing config
+    # server/config.go:139-145)
+    trace_cfg = cfg.get("tracing", {})
+    if trace_cfg.get("endpoint"):
+        from pilosa_tpu.obs.export import OTLPSpanExporter
+        from pilosa_tpu.obs.tracing import ExportingTracer, set_tracer
+
+        set_tracer(
+            ExportingTracer(
+                OTLPSpanExporter(trace_cfg["endpoint"]),
+                sample_rate=float(trace_cfg.get("sampler-param", 1.0)),
+            )
+        )
     # Periodic diagnostics flushes need somewhere to go (the reference
     # phones home; here a local JSONL sink). Without a sink the
     # /internal/diagnostics route serves snapshots on demand instead.
@@ -112,7 +129,7 @@ def cmd_server(args) -> int:
         node.diagnostics.sink_path = os.path.expanduser(diag_sink)
         node.diagnostics.start(float(metric_cfg.get("poll-interval", 60) or 60))
     node.start()
-    print(f"pilosa-tpu server listening on http://{host}:{node.server.port}, data dir {data_dir}")
+    print(f"pilosa-tpu server listening on {node.uri}, data dir {data_dir}")
     try:
         import threading
 
@@ -244,6 +261,8 @@ def main(argv=None) -> int:
     ps.add_argument("-d", "--data-dir", default=None)
     ps.add_argument("-b", "--bind", default=None)
     ps.add_argument("-c", "--config", default=None)
+    ps.add_argument("--tls-cert", default=None, help="TLS certificate path (enables HTTPS)")
+    ps.add_argument("--tls-key", default=None, help="TLS private key path")
     ps.set_defaults(fn=cmd_server)
 
     for name, fn in [("import", cmd_import)]:

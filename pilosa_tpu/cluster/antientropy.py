@@ -203,7 +203,8 @@ class HolderSyncer:
                 continue
             try:
                 data = self.client.block_data(
-                    node.uri, index, field, view, shard, block
+                    node.uri, index, field, view, shard, block,
+                    width=frag.shard_width,
                 )
                 pair_sets[node.id] = set(zip(data["rows"], data["cols"]))
             except ClientError as e:

@@ -24,24 +24,35 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
         self.timeout = timeout
+        # TLS: verification is skipped for self-signed intra-cluster
+        # certs (reference tls.skip-verify, server/config.go:36-152)
+        self._ssl_ctx = None
+        if skip_verify:
+            import ssl
+
+            self._ssl_ctx = ssl._create_unverified_context()
 
     # -- plumbing -----------------------------------------------------------
 
-    def _do(
+    def _do_full(
         self,
         method: str,
         uri: str,
         path: str,
         body: bytes | None = None,
         content_type: str = "application/json",
-    ) -> bytes:
+        accept: str | None = None,
+    ) -> tuple[bytes, str]:
+        """(body, response content-type)."""
         req = urllib.request.Request(
             uri.rstrip("/") + path, data=body, method=method
         )
         if body is not None:
             req.add_header("Content-Type", content_type)
+        if accept is not None:
+            req.add_header("Accept", accept)
         # Propagate the active trace across the node boundary (reference
         # tracing/opentracing.go:58-66 InjectHTTPHeaders).
         span = tracing.active_span()
@@ -51,13 +62,25 @@ class InternalClient:
             for k, v in hdrs.items():
                 req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as resp:
+                return resp.read(), resp.headers.get("Content-Type") or ""
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")[:500]
             raise ClientError(f"{method} {path}: {e.code} {detail}", e.code) from e
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise ClientError(f"{method} {path}: {e}") from e
+
+    def _do(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: bytes | None = None,
+        content_type: str = "application/json",
+    ) -> bytes:
+        return self._do_full(method, uri, path, body, content_type)[0]
 
     def _json(self, method: str, uri: str, path: str, obj: Any = None) -> Any:
         body = None if obj is None else json.dumps(obj).encode()
@@ -115,16 +138,34 @@ class InternalClient:
         return resp["blocks"]
 
     def block_data(
-        self, uri: str, index: str, field: str, view: str, shard: int, block: int
+        self, uri: str, index: str, field: str, view: str, shard: int,
+        block: int, width: int | None = None,
     ) -> dict:
-        """Row/col pairs of one block (reference BlockData)."""
-        return self._json(
+        """Row/col pairs of one block (reference BlockData). With
+        ``width`` (the fragment's shard width) the transfer is a packed
+        roaring blob of row*width+col positions; JSON only when the peer
+        declines (unencodable row ids or legacy node)."""
+        body = json.dumps(
+            {"index": index, "field": field, "view": view,
+             "shard": shard, "block": block}
+        ).encode()
+        out, ctype = self._do_full(
             "POST",
             uri,
             "/internal/fragment/block/data",
-            {"index": index, "field": field, "view": view,
-             "shard": shard, "block": block},
+            body,
+            accept="application/octet-stream" if width else None,
         )
+        if width and "application/octet-stream" in ctype:
+            from pilosa_tpu.storage import roaring
+
+            positions = roaring.deserialize(out)
+            w = int(width)
+            return {
+                "rows": (positions // w).tolist(),
+                "cols": (positions % w).tolist(),
+            }
+        return json.loads(out)
 
     def attr_blocks(self, uri: str, index: str, field: str | None) -> list[dict]:
         """Attr block checksums (reference http/client.go attr diff calls,
@@ -220,7 +261,7 @@ class NopInternalClient:
     def attr_block_data(self, uri, index, field, block):
         return {}
 
-    def block_data(self, uri, index, field, view, shard, block):
+    def block_data(self, uri, index, field, view, shard, block, width=None):
         return {"rows": [], "cols": []}
 
     def retrieve_fragment(self, uri, index, field, view, shard):

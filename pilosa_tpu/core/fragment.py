@@ -55,6 +55,17 @@ HASH_BLOCK_SIZE = 100
 
 _MIN_CAPACITY = 8
 
+# Paranoia mode: invariant checks after every mutation (the analogue of
+# the reference's `roaringparanoia` build tag, roaring/roaring_paranoia.go).
+import os as _os
+
+PARANOIA = bool(_os.environ.get("PILOSA_TPU_PARANOIA"))
+
+
+class FragmentInvariantError(AssertionError):
+    """Internal coherence violation between slot map, host mirror, and
+    device copy (reference Container.check, roaring.go:2967-3028)."""
+
 
 @jax.jit
 def _scatter_rows(device_bits, slots, rows):
@@ -158,6 +169,65 @@ class Fragment:
         self.op_n += 1
         if self.on_op is not None:
             self.on_op(self)
+        if PARANOIA:
+            self.check_invariants()
+
+    def check_invariants(self, device: bool = False) -> None:
+        """Verify slot-map ↔ host-mirror ↔ device-copy coherence; raises
+        FragmentInvariantError on violation (reference `ctl check` +
+        Container.check, ctl/check.go:47-133, roaring.go:2967-3028).
+        ``device=True`` additionally pulls the device copy to host and
+        compares every clean row — expensive, test-only."""
+        with self._lock:
+            if len(self._rowids) != len(self._slot_of):
+                raise FragmentInvariantError(
+                    f"rowids/slot_of size mismatch: "
+                    f"{len(self._rowids)} != {len(self._slot_of)}"
+                )
+            for r, s in self._slot_of.items():
+                if not (0 <= s < len(self._rowids)) or self._rowids[s] != r:
+                    raise FragmentInvariantError(
+                        f"slot map incoherent at row {r} -> slot {s}"
+                    )
+            if self._host.shape != (self.capacity, self.n_words):
+                raise FragmentInvariantError(
+                    f"host mirror shape {self._host.shape} != "
+                    f"({self.capacity}, {self.n_words})"
+                )
+            if len(self._rowids) > self.capacity:
+                raise FragmentInvariantError("more rows than capacity")
+            if self._host.dtype != np.uint32:
+                raise FragmentInvariantError(
+                    f"host mirror dtype {self._host.dtype}"
+                )
+            if self._counts is not None:
+                want = np.bitwise_count(
+                    self._host[: len(self._rowids)]
+                ).sum(axis=1)
+                if not np.array_equal(
+                    np.asarray(self._counts, dtype=np.int64),
+                    want.astype(np.int64),
+                ):
+                    raise FragmentInvariantError("stale row-count cache")
+            if device and self._device is not None:
+                dev = np.asarray(self._device)
+                if dev.shape != (self.capacity + 1, self.n_words):
+                    raise FragmentInvariantError(
+                        f"device copy shape {dev.shape}"
+                    )
+                if dev[self.capacity].any():
+                    raise FragmentInvariantError("zero row is not zero")
+                clean = [
+                    s
+                    for s in range(len(self._rowids))
+                    if s not in self._dirty
+                ]
+                if clean and not np.array_equal(
+                    dev[clean], self._host[clean]
+                ):
+                    raise FragmentInvariantError(
+                        "device copy diverged from host mirror on clean rows"
+                    )
 
     def _check_persistable(self, row: int) -> None:
         """With a store attached, reject un-persistable row ids BEFORE

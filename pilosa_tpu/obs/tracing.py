@@ -141,6 +141,36 @@ class RecordingTracer(Tracer):
             return out
 
 
+class ExportingTracer(RecordingTracer):
+    """Samples spans at the root and forwards finished spans to an
+    exporter (reference tracing/opentracing/opentracing.go:31-76 Jaeger
+    adapter + sampler config server/config.go:139-145).
+
+    Sampling is head-based per trace: the root span's trace id decides,
+    so a trace is exported whole or not at all."""
+
+    def __init__(self, exporter, sample_rate: float = 1.0, capacity: int = 4096):
+        super().__init__(capacity)
+        self.exporter = exporter
+        self.sample_rate = max(0.0, min(1.0, sample_rate))
+
+    def _sampled(self, trace_id: int) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        # cheap deterministic hash of the trace id -> [0, 1)
+        return ((trace_id * 2654435761) & 0xFFFFFFFF) / 2**32 < self.sample_rate
+
+    def _record(self, span: Span) -> None:
+        super()._record(span)
+        if self._sampled(span.context.trace_id):
+            self.exporter.export(span)
+
+    def close(self) -> None:
+        self.exporter.close()
+
+
 # Global tracer (reference tracing.GlobalTracer :22-29).
 _global = Tracer.__new__(NopTracer)  # type: ignore[assignment]
 

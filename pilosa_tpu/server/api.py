@@ -621,6 +621,28 @@ class API:
         rows, cols = frag.block_data(int(req["block"]))
         return {"rows": rows, "cols": cols}
 
+    def fragment_block_data_binary(self, req: dict) -> bytes | None:
+        """Packed-binary block payload: the block's set bits as a roaring
+        blob of row*width+col positions — a diverged 10M-bit block moves
+        as compressed containers instead of JSON int lists (reference
+        ships blocks via protobuf, encoding/proto/proto.go). None when a
+        row id exceeds the position encoding (caller falls back to
+        JSON)."""
+        self._validate("FragmentBlockData")
+        frag = self._fragment(
+            req["index"], req["field"], req.get("view", VIEW_STANDARD),
+            int(req["shard"]),
+        )
+        rows, cols = frag.block_data(int(req["block"]))
+        width = frag.shard_width
+        max_row = (2**64 - 1 - (width - 1)) // width
+        if any(r > max_row for r in rows):
+            return None
+        positions = np.asarray(rows, dtype=np.uint64) * np.uint64(width) + np.asarray(
+            cols, dtype=np.uint64
+        )
+        return roaring.serialize(np.sort(positions))
+
     def _attr_store(self, index: str, field: str | None):
         idx = self.holder.index(index)
         if idx is None:

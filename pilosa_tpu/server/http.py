@@ -322,7 +322,15 @@ class Handler(BaseHTTPRequestHandler):
         )
 
     def r_fragment_block_data(self):
-        self._send_json(200, self.api.fragment_block_data(self._json_body()))
+        body = self._json_body()
+        # Binary when the peer accepts it (packed roaring positions);
+        # JSON fallback for unencodable row ids or legacy peers.
+        if "application/octet-stream" in (self.headers.get("Accept") or ""):
+            data = self.api.fragment_block_data_binary(body)
+            if data is not None:
+                self._send(200, data, content_type="application/octet-stream")
+                return
+        self._send_json(200, self.api.fragment_block_data(body))
 
     def r_fragment_data(self):
         p = {k: v[0] for k, v in self.query_params.items()}
@@ -359,9 +367,20 @@ class Handler(BaseHTTPRequestHandler):
 
 
 class Server:
-    """HTTP server wrapper: bind, serve in background, close."""
+    """HTTP server wrapper: bind, serve in background, close.
 
-    def __init__(self, api: API, host: str = "localhost", port: int = 10101, long_query_time: float = 0.0):
+    With ``tls_cert``/``tls_key`` the listener speaks HTTPS (reference
+    TLS config server/config.go:36-152; node URIs become https://)."""
+
+    def __init__(
+        self,
+        api: API,
+        host: str = "localhost",
+        port: int = 10101,
+        long_query_time: float = 0.0,
+        tls_cert: str | None = None,
+        tls_key: str | None = None,
+    ):
         handler = type(
             "BoundHandler",
             (Handler,),
@@ -372,6 +391,15 @@ class Server:
             },
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
         self.api = api
         self._thread: threading.Thread | None = None
 

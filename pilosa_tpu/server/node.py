@@ -36,8 +36,12 @@ class NodeServer:
         long_query_time: float = 0.0,
         stats_client=None,
         metric_poll_interval: float = 10.0,
+        tls_cert: str | None = None,
+        tls_key: str | None = None,
+        tls_skip_verify: bool = False,
     ):
         self.host = host
+        self.tls = bool(tls_cert)
         self.holder = Holder(n_words)
         # Metrics backend; MemStatsClient serves /metrics + /debug/vars
         # (reference server.go:397-411 metric.service selection).
@@ -52,7 +56,7 @@ class NodeServer:
             self.store.open()
         node_id = self.store.node_id() if self.store else uuid.uuid4().hex
         self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
-        self.client = InternalClient()
+        self.client = InternalClient(skip_verify=tls_skip_verify or self.tls)
         self.broadcaster = HTTPBroadcaster(self.cluster, self.client, node_id)
         self.api = API(
             self.holder,
@@ -73,7 +77,12 @@ class NodeServer:
         if self.api.dist is not None:
             self.api.dist.local.translator = proxy
         self.server = Server(
-            self.api, host=host, port=port, long_query_time=long_query_time
+            self.api,
+            host=host,
+            port=port,
+            long_query_time=long_query_time,
+            tls_cert=tls_cert,
+            tls_key=tls_key,
         )
         # Diagnostics + runtime metrics loops (reference server.go:433-436
         # monitorDiagnostics/monitorRuntime, gcnotify).
@@ -161,7 +170,8 @@ class NodeServer:
 
     @property
     def uri(self) -> str:
-        return f"http://{self.host}:{self.server.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.server.port}"
 
     @property
     def node_id(self) -> str:

@@ -38,6 +38,44 @@ def test_fragment_blocks_and_block_data():
     assert frag.blocks()[0]["checksum"] != before
 
 
+def test_block_data_travels_as_packed_binary():
+    """A large diverged block must move as a packed roaring blob, not
+    JSON int lists (reference ships blocks via protobuf,
+    encoding/proto/proto.go); the JSON path remains as fallback and both
+    decode identically."""
+    import json
+
+    from pilosa_tpu.cluster.client import InternalClient
+
+    with InProcessCluster(1) as c:
+        node = c.nodes[0]
+        c.create_index("bw")
+        c.create_field("bw", "f")
+        rng = np.random.default_rng(2)
+        bits = [
+            (int(r), int(col))
+            for r in range(40)
+            for col in rng.integers(0, 3000, size=250)
+        ]
+        c.import_bits("bw", "f", bits)
+        shard = sorted(_local_shards(node, "bw", "f"))[0]
+        frag = node.holder.fragment("bw", "f", "standard", shard)
+        client = InternalClient()
+        binary = client.block_data(
+            node.uri, "bw", "f", "standard", shard, 0, width=frag.shard_width
+        )
+        legacy = client.block_data(node.uri, "bw", "f", "standard", shard, 0)
+        assert binary["rows"] == legacy["rows"]
+        assert binary["cols"] == legacy["cols"]
+        assert len(binary["rows"]) > 5000
+        # the packed payload is materially smaller than the JSON body
+        packed = node.api.fragment_block_data_binary(
+            {"index": "bw", "field": "f", "shard": shard, "block": 0}
+        )
+        json_len = len(json.dumps(legacy).encode())
+        assert packed is not None and len(packed) * 3 < json_len
+
+
 # -- anti-entropy -----------------------------------------------------------
 
 

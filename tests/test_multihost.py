@@ -1,0 +1,102 @@
+"""Two-process jax.distributed smoke test for init_multihost
+(parallel/mesh.py): each process contributes 2 virtual CPU devices, the
+global mesh spans 4, and one sharded query computes the same count every
+process sees — documenting the multi-host story instead of asserting it
+(reference scales hosts via gossip+HTTP, SURVEY §2.4; the TPU-native
+data plane is the JAX distributed runtime + collectives)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+sys.path.insert(0, os.environ["REPO"])
+from pilosa_tpu.parallel.mesh import init_multihost
+
+pid = int(sys.argv[1])
+mesh = init_multihost(
+    coordinator_address=os.environ["COORD"],
+    num_processes=2,
+    process_id=pid,
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+import jax.numpy as jnp
+from jax import lax
+
+spec = NamedSharding(mesh, P("shards", None, None))
+
+S, R, W = mesh.shape["shards"] * 2, mesh.shape["rows"] * 2, 64
+rng = np.random.default_rng(0)
+bits_np = rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint32)
+
+# every process materializes its local slice of the global array
+def make_global(np_arr):
+    arrays = []
+    for d in mesh.local_devices:
+        idx = jax.sharding.NamedSharding(mesh, P("shards", None, None)).addressable_devices_indices_map((S, R, W))[d]
+        arrays.append(jax.device_put(np_arr[idx], d))
+    return jax.make_array_from_single_device_arrays((S, R, W), spec, arrays)
+
+bits = make_global(bits_np)
+
+@jax.jit
+def count_pair(bits):
+    words = bits[:, 0] & bits[:, 1]
+    return jnp.sum(lax.population_count(words).astype(jnp.int64))
+
+got = int(count_pair(bits))
+want = int(np.bitwise_count(bits_np[:, 0] & bits_np[:, 1]).sum())
+assert got == want, (got, want)
+print(f"proc{pid} OK {got}", flush=True)
+"""
+
+
+def test_two_process_distributed_query(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(
+        os.environ,
+        REPO=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        COORD=coord,
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out.decode(errors="replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung: " + " | ".join(outs))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"proc{i} failed:\n{outs[i]}"
+    assert "proc0 OK" in outs[0]
+    assert "proc1 OK" in outs[1]
