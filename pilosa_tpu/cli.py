@@ -107,6 +107,9 @@ def cmd_server(args) -> int:
         tls_cert=args.tls_cert or tls_cfg.get("certificate") or None,
         tls_key=args.tls_key or tls_cfg.get("key") or None,
         tls_skip_verify=bool(tls_cfg.get("skip-verify", False)),
+        tls_ca_cert=getattr(args, "tls_ca_cert", None)
+        or tls_cfg.get("ca-certificate")
+        or None,
     )
     # tracing exporter + sampler (reference tracing config
     # server/config.go:139-145)
@@ -263,6 +266,11 @@ def main(argv=None) -> int:
     ps.add_argument("-c", "--config", default=None)
     ps.add_argument("--tls-cert", default=None, help="TLS certificate path (enables HTTPS)")
     ps.add_argument("--tls-key", default=None, help="TLS private key path")
+    ps.add_argument(
+        "--tls-ca-cert",
+        default=None,
+        help="CA bundle for verifying intra-cluster certs (private CA)",
+    )
     ps.set_defaults(fn=cmd_server)
 
     for name, fn in [("import", cmd_import)]:

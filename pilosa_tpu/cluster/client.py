@@ -24,15 +24,28 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
+    def __init__(
+        self,
+        timeout: float = 30.0,
+        skip_verify: bool = False,
+        ca_cert: str | None = None,
+    ):
         self.timeout = timeout
-        # TLS: verification is skipped for self-signed intra-cluster
-        # certs (reference tls.skip-verify, server/config.go:36-152)
+        # TLS: a None context means urlopen verifies with the default
+        # verifying context; ``ca_cert`` pins a private CA for
+        # intra-cluster certs, and verification is only skipped when the
+        # operator explicitly opts in (reference honours tls.skip-verify
+        # only when set, server/server.go:230; CA option
+        # server/config.go:36-152 tls.ca-certificate).
         self._ssl_ctx = None
         if skip_verify:
             import ssl
 
             self._ssl_ctx = ssl._create_unverified_context()
+        elif ca_cert:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
 
     # -- plumbing -----------------------------------------------------------
 

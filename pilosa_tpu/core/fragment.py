@@ -67,6 +67,26 @@ class FragmentInvariantError(AssertionError):
     device copy (reference Container.check, roaring.go:2967-3028)."""
 
 
+def _retry_evict(ref) -> None:
+    """Complete a deferred HBM eviction from a lock-free thread: blocking
+    acquire is safe here because this thread holds no fragment locks."""
+    f = ref()
+    if f is None:
+        return
+    with f._lock:
+        if f._evict_pending:
+            f._evict_pending = False
+            f._device = None
+            f._dirty.clear()
+            # The flag may be stale: a concurrent device_bits can have
+            # re-admitted the copy after the deferral was recorded.  The
+            # accounting must follow the copy we just dropped, or the
+            # budget over-counts those bytes forever (release is a no-op
+            # when the budget already evicted the entry).
+            if f._budget_key is not None:
+                membudget.default_budget().release(f._budget_key)
+
+
 @jax.jit
 def _scatter_rows(device_bits, slots, rows):
     return device_bits.at[slots].set(rows)
@@ -450,16 +470,28 @@ class Fragment:
             # fragment's lock (its own admit), and that fragment's evict
             # callback may want ours — blocking here is an AB-BA deadlock
             # between two fragments under concurrent serving threads.
-            # When contended, defer: the owner drops its copy at the next
-            # device sync (accounting was already removed by the budget).
+            # When contended, defer AND schedule a retry from a fresh
+            # thread (which holds no locks, so a blocking acquire is
+            # safe): without the retry, a fragment that is never queried
+            # again would keep its HBM copy resident while the budget
+            # reports the bytes reclaimed.
             if f._lock.acquire(blocking=False):
                 try:
                     f._device = None
                     f._dirty.clear()
+                    # A concurrent device_bits may have re-admitted the
+                    # entry between the budget's pop and this callback;
+                    # drop that accounting with the copy (no-op in the
+                    # common already-evicted case).
+                    if f._budget_key is not None:
+                        membudget.default_budget().release(f._budget_key)
                 finally:
                     f._lock.release()
             else:
                 f._evict_pending = True
+                t = threading.Timer(0.05, _retry_evict, args=(ref,))
+                t.daemon = True
+                t.start()
 
         return cb
 

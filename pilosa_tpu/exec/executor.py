@@ -14,6 +14,7 @@ Union/Xor/Not/Shift (executor.go:653-680)."""
 
 from __future__ import annotations
 
+import itertools
 import threading
 import weakref
 from datetime import datetime
@@ -178,6 +179,9 @@ class Executor:
     # stacks kept per (mesh, shard set); two entries so alternating shard
     # arguments don't evict each other every call
     _STACK_CACHE_ENTRIES = 2
+    # monotonic use stamps for LRU eviction (shared across executors —
+    # stamps only compare within one field's cache dict)
+    _stack_lru_clock = itertools.count()
 
     def _field_stack(self, field: Field, shards: list[int]):
         """(slot_of, bits[S, R, W] device tensor) for the field's standard
@@ -218,6 +222,12 @@ class Executor:
             caches = vars(field).setdefault("_stack_caches", {})
             entry = caches.get(cache_key)
             if entry is not None:
+                # LRU: stamp the entry on every hit; eviction below drops
+                # the min-stamp entry.  A stamp (vs dict pop/reinsert)
+                # leaves the budget's lock-free _evict pop as the only
+                # writer that removes keys, so no KeyError/resurrection
+                # race between a hit and a concurrent eviction.
+                entry["lru"] = next(self._stack_lru_clock)
                 if entry["versions"] == versions:
                     budget.touch(entry["bkey"])
                     return entry["slot_of"], entry["dev"]
@@ -259,8 +269,17 @@ class Executor:
                 dev = jnp.asarray(bits)
             self.stack_rebuilds += 1
             while len(caches) >= self._STACK_CACHE_ENTRIES:
-                old = caches.pop(next(iter(caches)))  # oldest entry first
-                budget.release(old["bkey"])
+                # the budget's _evict pops lock-free, so snapshot-scan and
+                # pop with defaults; retry when a concurrent pop races us
+                try:
+                    lru_key = min(
+                        caches, key=lambda k: caches.get(k, {}).get("lru", -1)
+                    )
+                except (RuntimeError, ValueError):
+                    continue  # dict mutated mid-scan; re-check the bound
+                old = caches.pop(lru_key, None)  # least recently used
+                if old is not None:
+                    budget.release(old["bkey"])
             # Each cache entry carries its OWN budget key (two stacks per
             # field may be live; one shared key would undercount) and is
             # released whenever the entry is dropped.
@@ -271,6 +290,7 @@ class Executor:
                 "slot_of": slot_of,
                 "dev": dev,
                 "bkey": bkey,
+                "lru": next(self._stack_lru_clock),
             }
             caches[cache_key] = entry
 

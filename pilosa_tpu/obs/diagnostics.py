@@ -18,6 +18,15 @@ import time
 from pilosa_tpu.obs.sysinfo import SystemInfo
 
 
+def _pallas_fallback_count() -> int:
+    try:
+        from pilosa_tpu.ops.kernels import pallas_fallback_count
+
+        return pallas_fallback_count()
+    except Exception:
+        return 0
+
+
 class Diagnostics:
     def __init__(self, holder, cluster=None, version: str = "", sink_path: str | None = None):
         self.holder = holder
@@ -65,6 +74,10 @@ class Diagnostics:
             "numFragments": num_fragments,
             "numShards": len(shards),
             "system": self.info.to_dict(),
+            # Silent Pallas→XLA kernel demotions after the backend was
+            # proven good — repeated failures signal device OOM or a
+            # miscompiled shape (kernels._note_pallas_fallback).
+            "pallasFallbacks": _pallas_fallback_count(),
         }
         with self._lock:
             report.update(self._extra)

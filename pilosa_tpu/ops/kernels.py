@@ -162,6 +162,30 @@ def pair_count_batched_xla(
 
 _pallas_ok: bool | None = None
 
+# Count of silent Pallas→XLA demotions after the backend was proven good
+# (an established _pallas_ok=True): device OOM or a miscompiled shape
+# would otherwise become invisible performance degradation.  Surfaced via
+# diagnostics (pallas_fallbacks) so operators can see repeated failures.
+_pallas_fallbacks: int = 0
+_PALLAS_FALLBACK_LOG_EVERY = 10
+
+
+def pallas_fallback_count() -> int:
+    return _pallas_fallbacks
+
+
+def _note_pallas_fallback(exc: Exception) -> None:
+    global _pallas_fallbacks
+    _pallas_fallbacks += 1
+    if _pallas_fallbacks % _PALLAS_FALLBACK_LOG_EVERY == 1:
+        import logging
+
+        logging.getLogger("pilosa_tpu.kernels").warning(
+            "pallas kernel demoted to XLA fallback (#%d): %r",
+            _pallas_fallbacks,
+            exc,
+        )
+
 
 def _multi_device(x) -> bool:
     """True when ``x`` is laid out across more than one device.
@@ -262,11 +286,13 @@ def _run_sharded(builder, builder_args, call_args) -> jax.Array:
                 jax.block_until_ready(out)
                 _pallas_ok = True
             return out
-        except Exception:
+        except Exception as exc:
             # match _try_pallas: an established True flag survives a
             # one-off shape failure; only an unproven backend demotes
             if _pallas_ok is None:
                 _pallas_ok = False
+            else:
+                _note_pallas_fallback(exc)
     return builder(*builder_args, False)(*call_args)
 
 
@@ -288,9 +314,11 @@ def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
             jax.block_until_ready(out)
             _pallas_ok = True
         return out
-    except Exception:
+    except Exception as exc:
         if _pallas_ok is None:
             _pallas_ok = False
+        else:
+            _note_pallas_fallback(exc)
         return fallback(*args, **kwargs)
 
 

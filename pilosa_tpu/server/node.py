@@ -39,6 +39,7 @@ class NodeServer:
         tls_cert: str | None = None,
         tls_key: str | None = None,
         tls_skip_verify: bool = False,
+        tls_ca_cert: str | None = None,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -56,7 +57,9 @@ class NodeServer:
             self.store.open()
         node_id = self.store.node_id() if self.store else uuid.uuid4().hex
         self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
-        self.client = InternalClient(skip_verify=tls_skip_verify or self.tls)
+        self.client = InternalClient(
+            skip_verify=tls_skip_verify, ca_cert=tls_ca_cert
+        )
         self.broadcaster = HTTPBroadcaster(self.cluster, self.client, node_id)
         self.api = API(
             self.holder,
