@@ -20,6 +20,9 @@ writes with no shard affinity broadcast to all nodes.
 
 from __future__ import annotations
 
+import concurrent.futures
+import contextvars
+import threading
 from typing import Any, Callable
 
 from pilosa_tpu import pql
@@ -52,11 +55,48 @@ class NoAvailableReplicaError(ExecuteError):
 class DistributedExecutor:
     """Cluster-aware executor wrapping the single-node Executor."""
 
+    # One fan-out pool per process would serialize independent queries'
+    # fans behind each other; per-executor keeps isolation simple and the
+    # thread count small (pool threads only block on remote HTTP I/O).
+    _FANOUT_WORKERS = 8
+
     def __init__(self, holder, cluster: Cluster, client, translator=None):
         self.holder = holder
         self.cluster = cluster
         self.client = client
         self.local = Executor(holder, translator=translator)
+        # Lazily created: single-node paths never pay for pool threads.
+        # Request threads (ThreadingHTTPServer) race on init and against
+        # close(), so both go through _pool_lock and a closed flag.
+        self._pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        self._closed = False
+
+    def _fanout_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._closed:
+                raise ExecuteError("executor is shut down")
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._FANOUT_WORKERS,
+                    thread_name_prefix="pilosa-fanout",
+                )
+            return self._pool
+
+    def _submit(self, fn, *args):
+        """Submit to the fan-out pool under the CALLER's contextvars
+        context, so the active trace span crosses the thread hop and
+        remote spans still join the coordinator's trace (reference
+        tracing/opentracing.go:58-66 header injection)."""
+        ctx = contextvars.copy_context()
+        return self._fanout_pool().submit(ctx.run, fn, *args)
+
+    def close(self) -> None:
+        with self._pool_lock:
+            self._closed = True
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     @property
     def _single(self) -> bool:
@@ -121,29 +161,63 @@ class DistributedExecutor:
             raise ExecuteError(f"{call.name}() column argument required")
         return col // (self.holder.n_words * 32)
 
+    def _submit_writes(
+        self, index_name: str, call: Call, by_node: dict[str, list[int] | None]
+    ) -> dict:
+        """Launch a write on several nodes CONCURRENTLY (the reference
+        fans replica writes from the coordinating goroutine,
+        executor.go:2140-2207); the caller overlaps its local apply and
+        then collects with ``_collect_writes``."""
+        return {
+            self._submit(
+                self.client.query_node,
+                self.cluster.node(node_id).uri,
+                index_name,
+                str(call),
+                nshards if nshards is not None else [],
+            ): node_id
+            for node_id, nshards in by_node.items()
+        }
+
+    @staticmethod
+    def _collect_writes(futures: dict) -> list[Any]:
+        """Remote raw results; any node failure propagates WITH the
+        failing node named — synchronous replica writes must not silently
+        drop a replica."""
+        out = []
+        for f in concurrent.futures.as_completed(futures):
+            try:
+                out.append(decode_results(f.result())[0])
+            except ClientError as e:
+                raise ClientError(
+                    f"replica write failed on node {futures[f]}: {e}", e.code
+                ) from e
+        return out
+
     def _execute_point_write(self, index_name: str, idx, call: Call) -> Any:
         """Apply on every replica of the shard (reference
         executor.go:2140-2207 executeSetBitField)."""
         shard = self._shard_of_write(call)
-        result = None
+        remote: dict[str, list[int] | None] = {}
+        local = False
         for node in self.cluster.shard_nodes(index_name, shard):
             if node.id == self.cluster.node_id:
-                result = self.local._execute_call(idx, call, [shard])
+                local = True
             else:
-                wire = self.client.query_node(
-                    node.uri, index_name, str(call), [shard]
-                )
-                remote = decode_results(wire)[0]
-                result = remote if result is None else (result or remote)
+                remote[node.id] = [shard]
+        futures = self._submit_writes(index_name, call, remote)
+        result = self.local._execute_call(idx, call, [shard]) if local else None
+        for r in self._collect_writes(futures):
+            result = r if result is None else (result or r)
         return result
 
     def _execute_broadcast_write(self, index_name: str, idx, call: Call) -> Any:
-        result = None
-        for node in self.cluster.nodes:
-            if node.id == self.cluster.node_id:
-                result = self.local._execute_call(idx, call, None)
-            else:
-                self.client.query_node(node.uri, index_name, str(call), [])
+        remote: dict[str, list[int] | None] = {
+            n.id: None for n in self.cluster.nodes if n.id != self.cluster.node_id
+        }
+        futures = self._submit_writes(index_name, call, remote)
+        result = self.local._execute_call(idx, call, None)
+        self._collect_writes(futures)
         return result
 
     def _execute_shard_write(
@@ -156,16 +230,12 @@ class DistributedExecutor:
         for s in shards:
             for node in self.cluster.shard_nodes(index_name, s):
                 by_replica.setdefault(node.id, []).append(s)
+        local_shards = by_replica.pop(self.cluster.node_id, None)
+        futures = self._submit_writes(index_name, call, by_replica)
         changed = False
-        for node_id, nshards in by_replica.items():
-            node = self.cluster.node(node_id)
-            if node_id == self.cluster.node_id:
-                changed |= bool(self.local._execute_call(idx, call, nshards))
-            else:
-                wire = self.client.query_node(
-                    node.uri, index_name, str(call), nshards
-                )
-                changed |= bool(decode_results(wire)[0])
+        if local_shards is not None:
+            changed |= bool(self.local._execute_call(idx, call, local_shards))
+        changed |= any(bool(r) for r in self._collect_writes(futures))
         return changed
 
     # -- map-reduce (reference executor.go:2454-2611) -----------------------
@@ -183,16 +253,31 @@ class DistributedExecutor:
             while pending:
                 groups = self._group_by_live_owner(index_name, pending, bad_nodes)
                 pending = []
-                for node_id, nshards in groups.items():
-                    node = self.cluster.node(node_id)
-                    if node_id == self.cluster.node_id:
-                        partials.append(self.local._execute_call(idx, call, nshards))
-                        continue
+                # Remote nodes are queried CONCURRENTLY (one pool task per
+                # node, the reference's goroutine-per-node mapper,
+                # executor.go:2520-2555) while the local shard group runs
+                # on the request thread; results are collected in arrival
+                # order and failed nodes' shards re-mapped onto remaining
+                # replicas for the next loop pass.
+                local_shards = groups.pop(self.cluster.node_id, None)
+                futures = {
+                    self._submit(
+                        self.client.query_node,
+                        self.cluster.node(node_id).uri,
+                        index_name,
+                        pql_text,
+                        nshards,
+                    ): (node_id, nshards)
+                    for node_id, nshards in groups.items()
+                }
+                if local_shards is not None:
+                    partials.append(
+                        self.local._execute_call(idx, call, local_shards)
+                    )
+                for fut in concurrent.futures.as_completed(futures):
+                    node_id, nshards = futures[fut]
                     try:
-                        wire = self.client.query_node(
-                            node.uri, index_name, pql_text, nshards
-                        )
-                        partials.append(decode_results(wire)[0])
+                        partials.append(decode_results(fut.result())[0])
                     except ClientError:
                         # Failover: re-map this node's shards onto remaining
                         # replicas (reference executor.go:2495-2506).

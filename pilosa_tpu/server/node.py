@@ -225,6 +225,8 @@ class NodeServer:
     def stop(self) -> None:
         if self.membership is not None:
             self.membership.stop()
+        if self.api.dist is not None:
+            self.api.dist.close()
         self.runtime_monitor.stop()
         self.diagnostics.stop()
         self.gc_notifier.close()
