@@ -390,7 +390,7 @@ class Executor:
             if stack is None:
                 continue
             slot_of, bits = stack
-            by_op: dict[str, list[tuple[int, int, int]]] = {}
+            launch: list[tuple[int, str, int, int]] = []
             for i, op, ra, rb in items:
                 sa, sb = slot_of.get(ra), slot_of.get(rb)
                 if sa is None or sb is None:
@@ -401,26 +401,51 @@ class Executor:
                         results[i] = 0
                         _count_stat()
                     continue
-                by_op.setdefault(op, []).append((i, sa, sb))
-            for op, launch in by_op.items():
-                B = 1 << (len(launch) - 1).bit_length()
-                ras = np.zeros(B, dtype=np.int32)
-                rbs = np.zeros(B, dtype=np.int32)
-                for j, (_, sa, sb) in enumerate(launch):
-                    ras[j], rbs[j] = sa, sb
-                with tracing.start_span("executor.batchPairCount").set_tag(
-                    "field", fname
-                ).set_tag("n", len(launch)):
-                    # [B, S] per-shard partials; summed host-side in int64
-                    # so totals past 2^31 stay exact (same rule as
-                    # Row.count's per-segment sum).
+                launch.append((i, op, sa, sb))
+            if not launch:
+                continue
+            # One gram launch answers ALL ops in the batch — each pair op
+            # is a formula over gram entries (|a|b| = Gaa+Gbb-Gab, ...),
+            # so mixed Intersect/Union/Difference/Xor Counts share one
+            # index scan on the MXU (kernels.pair_gram).
+            uniq = sorted({s for _, _, sa, sb in launch for s in (sa, sb)})
+            pos = {s: k for k, s in enumerate(uniq)}
+            with tracing.start_span("executor.batchPairCount").set_tag(
+                "field", fname
+            ).set_tag("n", len(launch)):
+                gram = kernels.pair_gram(bits, uniq)
+                if gram is not None:
+                    pa = np.array([pos[sa] for _, _, sa, _ in launch])
+                    pb = np.array([pos[sb] for _, _, _, sb in launch])
+                    for op in {op for _, op, _, _ in launch}:
+                        sel = [j for j, it in enumerate(launch) if it[1] == op]
+                        counts = kernels.pair_counts_from_gram(
+                            gram, pa[sel], pb[sel], op
+                        )
+                        for c, j in zip(counts, sel):
+                            results[launch[j][0]] = int(c)
+                            _count_stat()
+                    continue
+                # gram declined (too many distinct rows): scan kernels,
+                # one launch per op, padded to powers of two for program
+                # reuse.  [B, S] per-shard partials summed host-side in
+                # int64 so totals past 2^31 stay exact.
+                by_op: dict[str, list[tuple[int, int, int]]] = {}
+                for i, op, sa, sb in launch:
+                    by_op.setdefault(op, []).append((i, sa, sb))
+                for op, olaunch in by_op.items():
+                    B = 1 << (len(olaunch) - 1).bit_length()
+                    ras = np.zeros(B, dtype=np.int32)
+                    rbs = np.zeros(B, dtype=np.int32)
+                    for j, (_, sa, sb) in enumerate(olaunch):
+                        ras[j], rbs[j] = sa, sb
                     partials = np.asarray(
                         kernels.pair_count_batched(
                             bits, jnp.asarray(ras), jnp.asarray(rbs), op=op
                         )
                     ).astype(np.int64)
                     counts = partials.sum(axis=1)
-                    for j, (i, _, _) in enumerate(launch):
+                    for j, (i, _, _) in enumerate(olaunch):
                         results[i] = int(counts[j])
                         _count_stat()
 

@@ -129,3 +129,86 @@ def test_overflow_safe_paths(monkeypatch):
     order = np.argsort(-want, kind="stable")[:3]
     assert list(slots) == list(order)
     assert list(counts) == [int(want[s]) for s in order]
+
+# ---------------------------------------------------------------------------
+# MXU gram path
+# ---------------------------------------------------------------------------
+
+
+def test_gram_matrix_all_pairs():
+    rng = np.random.default_rng(21)
+    S, R, W = 3, 6, 128
+    bits = _rand_bits(rng, S, R, W)
+    g = np.asarray(kernels.gram_matrix_xla(jnp.asarray(bits)))
+    for i in range(R):
+        for j in range(R):
+            want = int(np.bitwise_count(bits[:, i] & bits[:, j]).sum())
+            assert g[i, j] == want
+
+
+def test_gram_gather_subset():
+    rng = np.random.default_rng(22)
+    S, R, W = 2, 9, 256
+    bits = _rand_bits(rng, S, R, W)
+    idx = np.array([7, 1, 4], np.int32)
+    g = np.asarray(kernels.gram_gather_xla(jnp.asarray(bits), jnp.asarray(idx)))
+    for a, ia in enumerate(idx):
+        for b, ib in enumerate(idx):
+            want = int(np.bitwise_count(bits[:, ia] & bits[:, ib]).sum())
+            assert g[a, b] == want
+
+
+def test_pair_gram_full_and_subset_and_decline():
+    rng = np.random.default_rng(23)
+    S, R, W = 2, 8, 128
+    bits = jnp.asarray(_rand_bits(rng, S, R, W))
+    # full-row gram
+    g = kernels.pair_gram(bits, list(range(R)))
+    assert g is not None and g.shape == (R, R) and g.dtype == np.int64
+    # subset
+    gs = kernels.pair_gram(bits, [3, 5])
+    assert gs is not None and gs.shape == (2, 2)
+    assert gs[0, 1] == g[3, 5] and gs[0, 0] == g[3, 3]
+    # declines on very wide row sets
+    assert kernels.pair_gram(bits, list(range(kernels.GRAM_MAX_ROWS + 1))) is None
+    assert kernels.pair_gram(bits, []) is None
+
+
+@pytest.mark.parametrize("op", ["intersect", "union", "difference", "xor"])
+def test_pair_counts_from_gram_formulas(op):
+    rng = np.random.default_rng(24)
+    S, R, W = 2, 6, 64
+    bits = _rand_bits(rng, S, R, W)
+    g = kernels.pair_gram(jnp.asarray(bits), list(range(R)))
+    B = 12
+    pa = rng.integers(0, R, size=B)
+    pb = rng.integers(0, R, size=B)
+    got = kernels.pair_counts_from_gram(g, pa, pb, op)
+    want = np.array(
+        [
+            np.bitwise_count(OPS_NP[op](bits[:, a], bits[:, b])).sum()
+            for a, b in zip(pa, pb)
+        ],
+        dtype=np.int64,
+    )
+    assert got.tolist() == want.tolist()
+
+
+def test_pair_gram_sharded_matches_single(eight_device_mesh=None):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs multi-device mesh")
+    rng = np.random.default_rng(25)
+    n = len(devs)
+    S, R, W = 2 * n, 5, 128
+    bits = _rand_bits(rng, S, R, W)
+    mesh = Mesh(np.array(devs), ("shards",))
+    dev = jax.device_put(bits, NamedSharding(mesh, P("shards", None, None)))
+    g_sharded = kernels.pair_gram(dev, list(range(R)))
+    g_single = kernels.pair_gram(jnp.asarray(bits), list(range(R)))
+    assert g_sharded.tolist() == g_single.tolist()
+    gs2 = kernels.pair_gram(dev, [1, 3])
+    assert gs2[0, 1] == g_single[1, 3]

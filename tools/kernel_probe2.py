@@ -1,0 +1,125 @@
+"""Probe 2: RTT, row-count variants, bigger-R gram scaling."""
+
+from __future__ import annotations
+
+import time
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from functools import partial
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, *args, reps=5, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    S, R, W = 160, 64, 32768
+    key = jax.random.PRNGKey(7)
+    k1, k2 = jax.random.split(key)
+    bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
+        k2, (S, R, W), dtype=jnp.uint32
+    )
+    bits = jax.block_until_ready(bits)
+    n_bits = S * R * W * 32
+
+    # RTT: trivial dispatch + host pull
+    one = jnp.zeros((), jnp.int32)
+    f = jax.jit(lambda x: x + 1)
+    t = timeit(f, one, reps=10)
+    print(f"RTT (trivial dispatch+pull): {t*1e3:.1f} ms")
+    rtt = t
+
+    # row_counts variants
+    @jax.jit
+    def rc_u32(bits):
+        return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=2)
+
+    @jax.jit
+    def rc_u8(bits):
+        b8 = lax.bitcast_convert_type(bits, jnp.uint8)  # [S,R,W,4]
+        return jnp.sum(lax.population_count(b8).astype(jnp.int32), axis=(2, 3))
+
+    @partial(jax.jit, static_argnames=("wb",))
+    def rc_mxu(bits, wb=4096):
+        S, R, W = bits.shape
+        nb = W // wb
+        blocks = bits.reshape(S, R, nb, wb).transpose(0, 2, 1, 3).reshape(
+            S * nb, R, wb
+        )
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        ones = jnp.ones((wb * 32, 128), jnp.int8)
+
+        def body(acc, blk):
+            x = ((blk[:, :, None] >> shifts) & 1).astype(jnp.int8).reshape(
+                R, wb * 32
+            )
+            g = lax.dot_general(
+                x, ones, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return acc + g[:, 0], None
+
+        acc, _ = lax.scan(body, jnp.zeros((R,), jnp.int32), blocks)
+        return acc
+
+    for name, fn in [("u32", rc_u32), ("u8", rc_u8), ("mxu", rc_mxu)]:
+        t = timeit(fn, bits)
+        print(
+            f"row_counts {name}: {t*1e3:.1f} ms raw, "
+            f"{(t-rtt)*1e3:.1f} ms net ({n_bits/8/max(t-rtt,1e-9)/1e9:.0f} GB/s)"
+        )
+
+    # verify
+    assert (np.asarray(rc_u8(bits)).sum(0) == np.asarray(rc_mxu(bits))).all()
+
+    # gram at larger R (U = gathered unique rows scaling): R=256
+    R2 = 256
+    bits2 = jax.random.bits(k1, (S, R2, W // 4), dtype=jnp.uint32)
+    bits2 = jax.block_until_ready(bits2)
+
+    @partial(jax.jit, static_argnames=("wb",))
+    def gram(bits, wb=4096):
+        S, R, W = bits.shape
+        nb = max(W // wb, 1)
+        wb = W // nb
+        blocks = bits.reshape(S, R, nb, wb).transpose(0, 2, 1, 3).reshape(
+            S * nb, R, wb
+        )
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        def body(acc, blk):
+            x = ((blk[:, :, None] >> shifts) & 1).astype(jnp.int8).reshape(
+                R, wb * 32
+            )
+            g = lax.dot_general(
+                x, x, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return acc + g, None
+
+        acc, _ = lax.scan(body, jnp.zeros((R, R), jnp.int32), blocks)
+        return acc
+
+    t = timeit(gram, bits2, reps=3)
+    print(
+        f"gram R=256 on {S*R2*(W//4)*32/1e9:.1f}e9 bits: {t*1e3:.1f} ms raw, "
+        f"{(t-rtt)*1e3:.1f} ms net"
+    )
+    t = timeit(gram, bits, reps=3)
+    print(f"gram R=64 10.7e9 bits: {t*1e3:.1f} ms raw, {(t-rtt)*1e3:.1f} ms net")
+
+
+if __name__ == "__main__":
+    main()
