@@ -373,14 +373,16 @@ def _gram_word_block(w: int) -> int:
     return max(wb, 1)
 
 
-@partial(jax.jit, static_argnames=("acc64",))
-def gram_matrix_xla(bits: jax.Array, *, acc64: bool = False) -> jax.Array:
+@jax.jit
+def gram_matrix_xla(bits: jax.Array) -> jax.Array:
     """``G[i, j] = sum_s popcount(bits[s, i] & bits[s, j])`` for ALL row
     pairs, as one scan of the index with an int8 matmul per word block on
-    the MXU (0/1 dot product == AND+popcount).  ``acc64`` selects an
-    int64 accumulator when a single pair's total can pass 2^31
-    (S * W * 32 >= 2^31); per-block partials are always int32-exact.
-    """
+    the MXU (0/1 dot product == AND+popcount).
+
+    int32 accumulation: per-block partials are <= wb*32 and callers
+    (:func:`pair_gram`) chunk the shard axis so S * W * 32 < 2^31 —
+    int64 cannot be used here because without ``jax_enable_x64`` JAX
+    silently narrows it back to int32."""
     S, R, W = bits.shape
     wb = _gram_word_block(W)
     nb = W // wb
@@ -396,32 +398,40 @@ def gram_matrix_xla(bits: jax.Array, *, acc64: bool = False) -> jax.Array:
             x, x, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32,
         )
-        return acc + (g.astype(jnp.int64) if acc64 else g), None
+        return acc + g, None
 
-    acc0 = jnp.zeros((R, R), jnp.int64 if acc64 else jnp.int32)
+    acc0 = jnp.zeros((R, R), jnp.int32)
     acc, _ = lax.scan(body, acc0, blocks)
     return acc
 
 
-@partial(jax.jit, static_argnames=("acc64",))
-def gram_gather_xla(
-    bits: jax.Array, idx: jax.Array, *, acc64: bool = False
-) -> jax.Array:
+@jax.jit
+def gram_gather_xla(bits: jax.Array, idx: jax.Array) -> jax.Array:
     """Gram over the row subset ``bits[:, idx]`` — the batch's distinct
     rows only, so the scan reads U/R of the index."""
-    return gram_matrix_xla(bits[:, idx], acc64=acc64)
+    return gram_matrix_xla(bits[:, idx])
+
+
+# Largest pair total an int32 gram accumulator may reach (tests shrink it
+# to exercise the chunked path on small shapes).
+_GRAM_ACC_LIMIT = 2**31 - 1
+
+
+def _gram_int32_safe(s: int, w: int) -> bool:
+    """A pair's total fits int32 while S * W * 32 <= the limit."""
+    return s * w * 32 <= _GRAM_ACC_LIMIT
 
 
 @lru_cache(maxsize=64)
-def _gram_sharded_fn(mesh, axis, gather, acc64):
+def _gram_sharded_fn(mesh, axis, gather):
     """jit(shard_map): per-device local gram partials stacked along the
     mesh axis -> [n_dev, R, R]; the host sums them in int64 (the ICI
     replacement for the reference's mapReduce reduce step)."""
     if gather:
-        local = lambda b, i: gram_gather_xla(b, i, acc64=acc64)[None]
+        local = lambda b, i: gram_gather_xla(b, i)[None]
         in_specs = (P(axis, None, None), P(None))
     else:
-        local = lambda b: gram_matrix_xla(b, acc64=acc64)[None]
+        local = lambda b: gram_matrix_xla(b)[None]
         in_specs = (P(axis, None, None),)
     return jax.jit(
         shard_map(
@@ -452,8 +462,6 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     U = len(row_idx)
     if U == 0 or U > GRAM_MAX_ROWS:
         return None
-    # int32 pair totals are safe while S * W * 32 < 2^31
-    acc64 = S * W * 32 >= 2**31
     full = U == R and list(row_idx) == list(range(R))
     if not full:
         # pad the gather to a power of two (repeating row 0) so jit
@@ -464,14 +472,31 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
-        fn = _gram_sharded_fn(mesh, axis, not full, acc64)
+        if not _gram_int32_safe(-(-S // mesh.devices.size), W):
+            # a device-local partial could wrap int32; callers fall back
+            # to the scan kernels' [B, S] per-shard partials
+            return None
+        fn = _gram_sharded_fn(mesh, axis, not full)
         out = fn(bits) if full else fn(bits, jnp.asarray(idx))
         return np.asarray(out).astype(np.int64).sum(axis=0)[:U, :U]
-    if full:
-        out = gram_matrix_xla(bits, acc64=acc64)
-    else:
-        out = gram_gather_xla(bits, jnp.asarray(idx), acc64=acc64)
-    return np.asarray(out).astype(np.int64)[:U, :U]
+    if _gram_int32_safe(S, W):
+        if full:
+            out = gram_matrix_xla(bits)
+        else:
+            out = gram_gather_xla(bits, jnp.asarray(idx))
+        return np.asarray(out).astype(np.int64)[:U, :U]
+    # Giant single-device index: chunk the shard axis so each chunk's
+    # partial gram is int32-exact, and sum the chunks in host int64
+    # (int64 on device is unavailable without jax_enable_x64).
+    chunk = max(1, _GRAM_ACC_LIMIT // (W * 32))
+    total = np.zeros((U, U) if full else (len(idx), len(idx)), np.int64)
+    for c0 in range(0, S, chunk):
+        blk = bits[c0 : c0 + chunk]
+        out = gram_matrix_xla(blk) if full else gram_gather_xla(
+            blk, jnp.asarray(idx)
+        )
+        total += np.asarray(out).astype(np.int64)
+    return total[:U, :U]
 
 
 def pair_counts_from_gram(

@@ -194,7 +194,7 @@ def test_pair_counts_from_gram_formulas(op):
     assert got.tolist() == want.tolist()
 
 
-def test_pair_gram_sharded_matches_single(eight_device_mesh=None):
+def test_pair_gram_sharded_matches_single():
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -212,3 +212,19 @@ def test_pair_gram_sharded_matches_single(eight_device_mesh=None):
     assert g_sharded.tolist() == g_single.tolist()
     gs2 = kernels.pair_gram(dev, [1, 3])
     assert gs2[0, 1] == g_single[1, 3]
+
+
+def test_pair_gram_chunked_when_int32_unsafe(monkeypatch):
+    """Giant single-device indexes take the shard-chunked host-int64 path
+    (device int64 is unavailable without jax_enable_x64)."""
+    rng = np.random.default_rng(26)
+    S, R, W = 6, 4, 64
+    bits = _rand_bits(rng, S, R, W)
+    want = kernels.pair_gram(jnp.asarray(bits), list(range(R)))
+    # shrink the accumulator limit so this small shape is "unsafe" and
+    # must chunk (2 shards per chunk here)
+    monkeypatch.setattr(kernels, "_GRAM_ACC_LIMIT", 2 * W * 32)
+    got = kernels.pair_gram(jnp.asarray(bits), list(range(R)))
+    assert got.tolist() == want.tolist()
+    got_sub = kernels.pair_gram(jnp.asarray(bits), [2, 0])
+    assert got_sub[0, 1] == want[2, 0]

@@ -1,123 +1,63 @@
-"""One-off kernel experiments on the live TPU chip.
+"""Serving-kernel probe: compare pair-count strategies on the live device.
 
-Compares candidate implementations of the batched pair-count and the
-TopN row scan to pick the fastest for the serving path. Not part of the
-framework; run manually: python tools/kernel_probe.py
+Run manually when tuning kernels (``python tools/kernel_probe.py``).
+Prints per-launch times for the MXU gram path, the XLA gather+popcount
+scan, and the TopN row scan on a bench-sized index.  Timing pulls each
+result to the host — through the dev relay, ``block_until_ready`` does
+not reliably wait, so a host pull is the only trustworthy barrier
+(pipelined rates issue all launches first and pull once).
 """
 
 from __future__ import annotations
 
-import time
 import sys
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
-from functools import partial
 
 sys.path.insert(0, ".")
 from pilosa_tpu.ops import kernels
 
 
-def timeit(fn, *args, reps=3, warmup=1):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+def pipelined(fn, args_list) -> float:
+    np.asarray(jax.tree.leaves(fn(*args_list[-1]))[0])  # compile
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        np.asarray(jax.tree.leaves(out)[0])  # force host sync through relay
-    return (time.perf_counter() - t0) / reps
+    outs = [fn(*a) for a in args_list]
+    np.asarray(jax.tree.leaves(outs[-1])[0])
+    return (time.perf_counter() - t0) / len(args_list)
 
 
-def main():
-    S, R, W = 160, 64, 32768
+def main() -> None:
+    S, R, W = (160, 64, 32768) if jax.default_backend() == "tpu" else (8, 16, 512)
     B = 1024
     key = jax.random.PRNGKey(7)
     k1, k2 = jax.random.split(key)
     bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
         k2, (S, R, W), dtype=jnp.uint32
     )
-    bits = jax.block_until_ready(bits)
+    np.asarray(bits)
+    n_bits = S * R * W * 32
     rng = np.random.default_rng(3)
     ras = jnp.asarray(rng.integers(0, R, size=B), jnp.int32)
     rbs = jnp.asarray(rng.integers(0, R, size=B), jnp.int32)
-    n_bits = S * R * W * 32
-    print(f"index: {n_bits/1e9:.1f}e9 bits, B={B}", file=sys.stderr)
+    salts = [jnp.uint32(i) for i in range(6)]
+    print(f"{jax.devices()[0]}: index {n_bits/1e9:.1f}e9 bits, B={B}")
 
-    # -- current Pallas pair-count kernel ---------------------------------
-    try:
-        t = timeit(lambda: kernels.pair_count_batched_pallas(bits, ras, rbs))
-        print(f"pallas pair_count: {t*1e3:.1f} ms -> {B/t:.0f} qps")
-    except Exception as e:
-        print(f"pallas pair_count: FAIL {type(e).__name__}")
+    t = pipelined(lambda s: kernels.gram_matrix_xla(bits ^ s), [(s,) for s in salts])
+    print(f"gram (all {R*R} pairs): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
 
-    # -- XLA scan fallback -------------------------------------------------
-    t = timeit(lambda: kernels.pair_count_batched_xla(bits, ras, rbs))
-    print(f"xla scan pair_count: {t*1e3:.1f} ms -> {B/t:.0f} qps")
+    t = pipelined(
+        lambda s: kernels.pair_count_batched_xla(bits ^ s, ras, rbs),
+        [(s,) for s in salts[:3]],
+    )
+    print(f"xla scan ({B} pairs): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps")
 
-    # -- gram-matrix via MXU (bf16) ---------------------------------------
-    @partial(jax.jit, static_argnames=("wb", "dtype"))
-    def gram(bits, wb=4096, dtype=jnp.bfloat16):
-        S, R, W = bits.shape
-        nb = W // wb
-        blocks = bits.reshape(S, R, nb, wb).transpose(0, 2, 1, 3).reshape(
-            S * nb, R, wb
-        )
-
-        shifts = jnp.arange(32, dtype=jnp.uint32)
-
-        def body(acc, blk):  # blk: [R, wb] uint32
-            x = ((blk[:, :, None] >> shifts) & 1).astype(dtype).reshape(R, wb * 32)
-            g = lax.dot_general(
-                x, x, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return acc + g.astype(jnp.int32), None
-
-        acc, _ = lax.scan(body, jnp.zeros((R, R), jnp.int32), blocks)
-        return acc
-
-    for dtype in (jnp.bfloat16, jnp.int8):
-        for wb in (2048, 4096, 8192):
-            try:
-                t = timeit(lambda: gram(bits, wb=wb, dtype=dtype))
-                g = np.asarray(gram(bits, wb=wb, dtype=dtype))
-                # answer the B queries by lookup
-                print(
-                    f"gram {dtype.__name__} wb={wb}: {t*1e3:.1f} ms "
-                    f"-> {B/t:.0f} qps (all {R*R} pairs)"
-                )
-            except Exception as e:
-                print(f"gram {dtype.__name__} wb={wb}: FAIL {type(e).__name__}: {e}")
-
-    # verify gram correctness vs XLA scan
-    g = np.asarray(gram(bits))
-    ref = np.asarray(kernels.pair_count_batched_xla(bits, ras, rbs)).sum(axis=1)
-    got = g[np.asarray(ras), np.asarray(rbs)]
-    assert (got == ref).all(), "gram mismatch!"
-    print("gram correctness: OK")
-
-    # -- row scan (TopN) ---------------------------------------------------
-    try:
-        t = timeit(lambda: kernels.row_counts_per_shard_pallas(bits))
-        bwt = n_bits / 8 / t / 1e9
-        print(f"pallas row_counts: {t*1e3:.1f} ms ({bwt:.0f} GB/s)")
-    except Exception as e:
-        print(f"pallas row_counts: FAIL {type(e).__name__}")
-    t = timeit(lambda: kernels.row_counts_per_shard_xla(bits))
-    bwt = n_bits / 8 / t / 1e9
-    print(f"xla row_counts: {t*1e3:.1f} ms ({bwt:.0f} GB/s)")
-
-    # xla with bigger accumulation order: popcount then reshape-sum
-    @jax.jit
-    def row_counts_xla2(bits):
-        pc = lax.population_count(bits)
-        return jnp.sum(pc.astype(jnp.int32), axis=2)
-
-    t = timeit(lambda: row_counts_xla2(bits))
-    print(f"xla row_counts v2: {t*1e3:.1f} ms ({n_bits/8/t/1e9:.0f} GB/s)")
+    t = pipelined(
+        lambda s: kernels.row_counts_per_shard_xla(bits ^ s), [(s,) for s in salts]
+    )
+    print(f"row scan: {t*1e3:.1f} ms ({n_bits/8/t/1e9:.0f} GB/s)")
 
 
 if __name__ == "__main__":
