@@ -1,0 +1,235 @@
+"""General PQL-AST -> one-launch compiler over serving field stacks.
+
+The reference executes arbitrary bitmap trees per shard inside its worker
+pool (executor.go:653-680 executeBitmapCallShard recursing over
+Row/Intersect/Union/Difference/Xor/Not).  The TPU analogue traces the
+SAME tree once into a single XLA program over the cached ``[S, R, W]``
+field stacks (SURVEY §7: "PQL AST -> traced JAX computation, one XLA
+program per query shape, cached"):
+
+* The program is cached by the AST's *shape* — the operator tree plus
+  which field each leaf reads — never by row ids.  Row ids arrive as an
+  ``int32`` slots input, so ``Count(Intersect(Row(f=1), Row(f=2)))`` and
+  ``Count(Intersect(Row(f=7), Row(f=9)))`` share one compiled program,
+  and a batch of same-shape Counts runs as ONE launch via an on-device
+  scan over the slot rows.
+* Absent rows ride through as slot ``-1``: the leaf gathers row 0 and
+  masks it to zero words, which is exactly the empty-row semantics of
+  every operator (including Not/Difference).
+* ``Not`` is rewritten at match time into
+  ``Difference(Row(_exists=0), child)`` — the reference's executeNot
+  (executor.go) against the existence field, as a plain tree node.
+
+Launches are counted in :data:`launches` so tests can assert O(1)
+dispatch per query batch regardless of shard count or tree width.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.pql.ast import Call
+
+# Device launches issued by compiled programs (tests assert O(1) per
+# batch; one count-group launch answers every same-shape Count).
+launches = 0
+
+_OPS = {
+    "Intersect": "intersect",
+    "Union": "union",
+    "Difference": "difference",
+    "Xor": "xor",
+}
+
+# sig nodes: ("row", field_name) | (op, *child_sigs)
+
+
+def _stackable_field(idx, fname: str):
+    """The field when its standard view can serve stacked reads."""
+    if fname is None:
+        return None
+    field = idx.field(fname)
+    if field is None or field.field_type == FIELD_TYPE_INT:
+        return None
+    if field.view(VIEW_STANDARD) is None:
+        return None
+    return field
+
+
+def match_tree(idx, call: Call, leaves: list[tuple[str, int]]):
+    """``sig`` for a batchable bitmap tree, appending its (field, row)
+    leaves in traversal order; None when any node falls outside the
+    compilable set (BSI conditions, time ranges, Shift, keyed rows...).
+    """
+    name = call.name
+    if name == "Row":
+        fname = call.field_arg()
+        field = _stackable_field(idx, fname)
+        if field is None or set(call.args) != {fname} or call.children:
+            return None
+        v = call.args.get(fname)
+        if not isinstance(v, int) or isinstance(v, bool):
+            return None
+        leaves.append((fname, v))
+        return ("row", fname)
+    if name == "Not":
+        # executeNot: exists-row difference (requires track_existence)
+        if len(call.children) != 1 or call.args or not idx.track_existence:
+            return None
+        ef = idx.existence_field()
+        if ef is None or ef.view(VIEW_STANDARD) is None:
+            return None
+        leaves.append((ef.name, 0))
+        child = match_tree(idx, call.children[0], leaves)
+        if child is None:
+            return None
+        return ("difference", ("row", ef.name), child)
+    op = _OPS.get(name)
+    if op is not None:
+        if not call.children or call.args:
+            return None
+        subs = []
+        for c in call.children:
+            s = match_tree(idx, c, leaves)
+            if s is None:
+                return None
+            subs.append(s)
+        return (op, *subs)
+    return None
+
+
+def match_count(idx, call: Call, leaves: list[tuple[str, int]]):
+    """sig for ``Count(tree)`` when the tree is compilable and not a bare
+    Row (plain row counts are already one gather on the segment path)."""
+    if call.name != "Count" or len(call.children) != 1 or call.args:
+        return None
+    child = call.children[0]
+    if child.name == "Row":
+        return None
+    return match_tree(idx, child, leaves)
+
+
+def sig_fields(sig) -> tuple[str, ...]:
+    """Distinct leaf fields in first-appearance order — the compiled
+    program's stack-argument order."""
+    out: list[str] = []
+
+    def walk(s):
+        if s[0] == "row":
+            if s[1] not in out:
+                out.append(s[1])
+            return
+        for k in s[1:]:
+            walk(k)
+
+    walk(sig)
+    return tuple(out)
+
+
+def _build(sig, findex: dict[str, int], ctr: list[int]):
+    """Recursively build the tree evaluator: (stacks, slots) -> [S, W]
+    words.  Leaf order mirrors match_tree's traversal order."""
+    if sig[0] == "row":
+        li = ctr[0]
+        ctr[0] += 1
+        fi = findex[sig[1]]
+
+        def leaf(stacks, slots, li=li, fi=fi):
+            s = slots[li]
+            row = stacks[fi][:, jnp.maximum(s, 0)]  # [S, W]
+            return row & jnp.where(
+                s >= 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+            )
+
+        return leaf
+    op = sig[0]
+    kids = [_build(k, findex, ctr) for k in sig[1:]]
+
+    if op == "difference":
+        if len(kids) == 1:
+            return kids[0]
+
+        # left fold a\b\c == a & ~(b | c) (reference row.go Difference)
+        def node(stacks, slots):
+            rest = kids[1](stacks, slots)
+            for k in kids[2:]:
+                rest = rest | k(stacks, slots)
+            return kids[0](stacks, slots) & ~rest
+
+        return node
+
+    fold = {"intersect": lambda a, b: a & b, "union": lambda a, b: a | b,
+            "xor": lambda a, b: a ^ b}[op]
+
+    def node(stacks, slots):
+        out = kids[0](stacks, slots)
+        for k in kids[1:]:
+            out = fold(out, k(stacks, slots))
+        return out
+
+    return node
+
+
+@lru_cache(maxsize=256)
+def compiled(sig, count_mode: bool):
+    """(jitted_fn, n_leaves) for an AST shape.  ``count_mode`` programs
+    take ``(stacks, slots[B, L])`` and return int32 ``[B, S]`` per-shard
+    counts (scan over the batch — no [B, S, W] materialization); bitmap
+    programs take ``(stacks, slots[L])`` and return the uint32 ``[S, W]``
+    result words."""
+    fields = sig_fields(sig)
+    findex = {f: i for i, f in enumerate(fields)}
+    ctr = [0]
+    root = _build(sig, findex, ctr)
+    n_leaves = ctr[0]
+
+    if count_mode:
+
+        @jax.jit
+        def run(stacks, slots_b):
+            def body(_, sl):
+                words = root(stacks, sl)
+                return None, jnp.sum(
+                    lax.population_count(words).astype(jnp.int32), axis=-1
+                )
+
+            _, counts = lax.scan(body, None, slots_b)
+            return counts  # [B, S]
+
+    else:
+
+        @jax.jit
+        def run(stacks, slots):
+            return root(stacks, slots)  # [S, W]
+
+    return run, n_leaves
+
+
+def run_count_batch(sig, stacks: tuple, slots_np: np.ndarray) -> np.ndarray:
+    """One launch: int64 totals for a batch of same-shape Counts.
+    ``slots_np`` is int32 [B, L] (pad rows with -1 slots are fine — they
+    count zero and callers slice them off)."""
+    global launches
+    fn, n_leaves = compiled(sig, True)
+    assert slots_np.shape[1] == n_leaves
+    launches += 1
+    partials = np.asarray(fn(stacks, jnp.asarray(slots_np))).astype(np.int64)
+    return partials.sum(axis=1)
+
+
+def run_bitmap(sig, stacks: tuple, slots_np: np.ndarray):
+    """One launch: the uint32 [S, W] result words of a bitmap tree."""
+    global launches
+    fn, n_leaves = compiled(sig, False)
+    assert slots_np.shape[0] == n_leaves
+    launches += 1
+    return fn(stacks, jnp.asarray(slots_np))

@@ -81,6 +81,12 @@ _WRITE_CALLS = {
 }
 
 
+def _pow2(n: int) -> int:
+    """Batch sizes pad to powers of two so jit programs are reused
+    across drifting batch sizes."""
+    return 1 << (n - 1).bit_length()
+
+
 def _is_write(call: Call) -> bool:
     """A call writes if it or any descendant writes — Options() (and any
     future wrapper) can wrap a write, so the barrier walks the tree."""
@@ -130,9 +136,17 @@ class Executor:
             for call in calls:
                 self._translate_call(idx, call)
             results: list[Any] = [_UNSET] * len(calls)
-            # Serving-mode fast path: many Count(op(Row,Row)) calls in one
-            # query collapse into a single batched device launch.
-            self._batch_pair_counts(idx, calls, shards, results)
+            # Serving-mode fast paths: many Count(op(Row,Row)) calls in
+            # one query collapse into a single gram launch, and arbitrary
+            # Row/op/Not trees compile into one traced program per AST
+            # shape (exec/astbatch.py).  Only calls BEFORE the first
+            # write are eligible: they observe exactly the pre-loop
+            # state they would see executing in order.
+            first_write = next(
+                (i for i, c in enumerate(calls) if _is_write(c)), len(calls)
+            )
+            self._batch_pair_counts(idx, calls[:first_write], shards, results)
+            self._batch_general(idx, calls[:first_write], shards, results)
             for i, call in enumerate(calls):
                 if results[i] is _UNSET:
                     with tracing.start_span(f"executor.execute{call.name}"):
@@ -345,40 +359,37 @@ class Executor:
         self.stack_incremental += 1
         return slot_of, dev
 
+    def _count_stat(self, idx: Index, call_name: str = "Count") -> None:
+        """query_total stat for a batch-answered call (the per-call path
+        emits this in _execute_call; batch paths must match)."""
+        self.holder.stats.count_with_tags(
+            "query_total", 1, 1.0, (f"index:{idx.name}", f"call:{call_name}")
+        )
+
     def _batch_pair_counts(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
         results: list[Any],
     ) -> None:
-        """Answer every batchable Count(op(Row,Row)) call in ``calls`` with
-        one device launch per (field, op) group — the serving-mode shape
-        where the reference would run one goroutine map-reduce per query
-        (executor.go:2454-2518). Launch batches pad to powers of two so
-        jit programs are reused across batch sizes.
+        """Answer every batchable Count(op(Row,Row)) call in ``calls``
+        (the caller has already truncated at the first write barrier)
+        with one gram launch per field — the serving-mode shape where the
+        reference would run one goroutine map-reduce per query
+        (executor.go:2454-2518).
 
-        Only calls BEFORE the first write call are eligible: they observe
-        exactly the pre-loop state they would see executing in order.
         A field engages only when >= 2 of its Counts batch (the stack
         build is full-field; version-keyed caching makes it pay off on
         read-heavy serving workloads, while write-interleaved workloads
         fall through to the per-call path)."""
         from pilosa_tpu.ops import kernels
 
-        first_write = next(
-            (i for i, c in enumerate(calls) if _is_write(c)),
-            len(calls),
-        )
         by_field: dict[str, list[tuple[int, str, int, int]]] = {}
-        for i, call in enumerate(calls[:first_write]):
+        for i, call in enumerate(calls):
             m = self._match_pair_count(idx, call)
             if m is not None:
                 fname, op, ra, rb = m
                 by_field.setdefault(fname, []).append((i, op, ra, rb))
         shard_list = None
-
-        def _count_stat() -> None:
-            self.holder.stats.count_with_tags(
-                "query_total", 1, 1.0, (f"index:{idx.name}", "call:Count")
-            )
+        _count_stat = lambda: self._count_stat(idx)
 
         for fname, items in by_field.items():
             if len(items) < 2:
@@ -434,7 +445,7 @@ class Executor:
                 for i, op, sa, sb in launch:
                     by_op.setdefault(op, []).append((i, sa, sb))
                 for op, olaunch in by_op.items():
-                    B = 1 << (len(olaunch) - 1).bit_length()
+                    B = _pow2(len(olaunch))
                     ras = np.zeros(B, dtype=np.int32)
                     rbs = np.zeros(B, dtype=np.int32)
                     for j, (_, sa, sb) in enumerate(olaunch):
@@ -448,6 +459,131 @@ class Executor:
                     for j, (i, _, _) in enumerate(olaunch):
                         results[i] = int(counts[j])
                         _count_stat()
+
+    # ------------------------------------------ general AST one-launch path
+
+    def _stack_cached(self, field: Field, shard_list: list[int]) -> bool:
+        """Whether a serving stack for this (field, shards) is already
+        live — a peek that never builds."""
+        from pilosa_tpu.parallel.mesh import serving_mesh
+
+        caches = getattr(field, "_stack_caches", None)
+        if not caches:
+            return False
+        return (serving_mesh(), tuple(shard_list)) in caches
+
+    def _batch_general(
+        self, idx: Index, calls: list[Call], shards: list[int] | None,
+        results: list[Any],
+    ) -> None:
+        """Compile remaining batchable reads — any tree of
+        Row/Intersect/Union/Difference/Xor/Not, under Count or as a
+        bitmap result — into one traced launch per AST shape over the
+        field stacks (SURVEY §7's "one XLA program per query shape";
+        reference semantics executor.go:653-680).
+
+        The caller truncates ``calls`` at the first write barrier.  A
+        call engages only when every leaf field either already has a
+        live stack or is demanded by >= 2 batchable calls in this query
+        (stack builds are full-field uploads; they must amortize)."""
+        from pilosa_tpu.exec import astbatch
+
+        count_groups: dict[tuple, list[tuple[int, list]]] = {}
+        bitmap_items: list[tuple[int, tuple, list]] = []
+        demand: dict[str, int] = {}
+        for i, call in enumerate(calls):
+            if results[i] is not _UNSET:
+                continue
+            leaves: list[tuple[str, int]] = []
+            sig = astbatch.match_count(idx, call, leaves)
+            if sig is not None:
+                count_groups.setdefault(sig, []).append((i, leaves))
+            elif call.name in ("Intersect", "Union", "Difference", "Xor", "Not"):
+                leaves = []
+                sig = astbatch.match_tree(idx, call, leaves)
+                if sig is None:
+                    continue
+                bitmap_items.append((i, sig, leaves))
+            else:
+                continue
+            for f in astbatch.sig_fields(sig):
+                demand[f] = demand.get(f, 0) + 1
+        if not count_groups and not bitmap_items:
+            return
+        shard_list = self._shards_for(idx, shards)
+
+        stacks_by_field: dict[str, Any] = {}
+
+        def _stacks_for(sig):
+            """(stacks tuple, slot_of per field) or None when any field
+            declines (cold + under-demanded, or over budget)."""
+            fields = astbatch.sig_fields(sig)
+            out = []
+            slot_maps = {}
+            for fname in fields:
+                if fname not in stacks_by_field:
+                    field = idx.field(fname)  # includes _exists
+                    if field is None:
+                        stacks_by_field[fname] = None
+                    elif demand.get(fname, 0) >= 2 or self._stack_cached(
+                        field, shard_list
+                    ):
+                        stacks_by_field[fname] = self._field_stack(
+                            field, shard_list
+                        )
+                    else:
+                        stacks_by_field[fname] = None
+                entry = stacks_by_field[fname]
+                if entry is None:
+                    return None
+                slot_maps[fname] = entry[0]
+                out.append(entry[1])
+            return tuple(out), slot_maps
+
+        def _slots_of(leaves, slot_maps) -> np.ndarray:
+            # absent rows -> slot -1 (masked to zero words in the leaf)
+            return np.array(
+                [slot_maps[f].get(r, -1) for f, r in leaves], np.int32
+            )
+
+        for sig, items in count_groups.items():
+            st = _stacks_for(sig)
+            if st is None:
+                continue
+            stacks, slot_maps = st
+            B = _pow2(len(items))
+            slots = np.full((B, len(items[0][1])), -1, np.int32)
+            for j, (_, leaves) in enumerate(items):
+                slots[j] = _slots_of(leaves, slot_maps)
+            with tracing.start_span("executor.batchCountTree").set_tag(
+                "n", len(items)
+            ):
+                totals = astbatch.run_count_batch(sig, stacks, slots)
+            for j, (i, _) in enumerate(items):
+                results[i] = int(totals[j])
+                self._count_stat(idx)
+
+        for i, sig, leaves in bitmap_items:
+            st = _stacks_for(sig)
+            if st is None:
+                continue
+            stacks, slot_maps = st
+            with tracing.start_span("executor.batchBitmapTree"):
+                dev = astbatch.run_bitmap(
+                    sig, stacks, _slots_of(leaves, slot_maps)
+                )
+            if getattr(dev, "sharding", None) is not None and len(
+                getattr(dev.sharding, "device_set", ())
+            ) > 1:
+                # mesh-sharded result: one host pull, numpy segments
+                # (device slices would pin segments to different chips
+                # and later segment algebra would mix placements)
+                dev = np.asarray(dev)
+            segments = {
+                s: dev[si] for si, s in enumerate(shard_list)
+            }
+            results[i] = Row(segments, n_words=idx.n_words)
+            self._count_stat(idx, calls[i].name)
 
     # ------------------------------------------------------- key translation
 

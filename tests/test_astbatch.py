@@ -1,0 +1,154 @@
+"""General AST one-launch path: arbitrary Row/op/Not trees compile into
+one traced program per AST shape over the field stacks and must return
+exactly what the per-fragment segment path returns (SURVEY §7 "one XLA
+program per query shape"; reference semantics executor.go:653-680)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import astbatch
+from pilosa_tpu.exec.executor import Executor
+
+
+@pytest.fixture()
+def setup():
+    h = Holder()
+    idx = h.create_index("i", track_existence=True)
+    idx.create_field("f")
+    idx.create_field("g")
+    ex = Executor(h)
+    rng = np.random.default_rng(9)
+    writes = []
+    pool = rng.integers(0, 3 * h.n_words * 32, size=150)
+    for row in range(6):
+        for col in rng.choice(pool, size=60, replace=False):
+            writes.append(f"Set({int(col)}, f={row})")
+    for row in range(3):
+        for col in rng.choice(pool, size=40, replace=False):
+            writes.append(f"Set({int(col)}, g={row})")
+    ex.execute("i", " ".join(writes))
+    return h, ex
+
+
+def _fresh_executor(h):
+    """An executor whose batch paths are disabled — the ground-truth
+    per-fragment segment path."""
+    ex = Executor(h)
+    ex._batch_pair_counts = lambda *a, **k: None
+    ex._batch_general = lambda *a, **k: None
+    return ex
+
+
+TREES = [
+    "Intersect(Row(f=0), Row(f=1), Row(f=2))",
+    "Union(Row(f=0), Row(f=1), Row(f=2), Row(f=3))",
+    "Difference(Row(f=0), Row(f=1), Row(f=2))",
+    "Xor(Row(f=0), Row(f=4))",
+    "Union(Intersect(Row(f=0), Row(g=1)), Difference(Row(f=2), Row(g=0)))",
+    "Not(Row(f=3))",
+    "Intersect(Row(f=1), Not(Union(Row(f=2), Row(g=2))))",
+    # absent rows ride through as zero rows
+    "Union(Row(f=0), Row(f=999))",
+    "Difference(Row(f=0), Row(f=999))",
+]
+
+
+@pytest.mark.parametrize("tree", TREES)
+def test_count_tree_matches_segment_path(setup, tree):
+    h, ex = setup
+    q = f"Count({tree})Count({tree})"  # x2: meets the stack-demand policy
+    got = ex.execute("i", q)
+    want = _fresh_executor(h).execute("i", q)
+    assert got == want
+    assert got[0] == got[1]
+
+
+@pytest.mark.parametrize("tree", TREES)
+def test_bitmap_tree_matches_segment_path(setup, tree):
+    h, ex = setup
+    q = f"{tree}{tree}"
+    got = ex.execute("i", q)
+    want = _fresh_executor(h).execute("i", q)
+    for g, w in zip(got, want):
+        assert sorted(g.columns().tolist()) == sorted(w.columns().tolist())
+        assert g.count() == w.count()
+
+
+def test_count_batch_is_one_launch(setup):
+    _, ex = setup
+    # warm the stacks + compile cache
+    ex.execute("i", "Count(Intersect(Row(f=0), Row(f=1), Row(f=2)))" * 2)
+    before = astbatch.launches
+    q = "".join(
+        f"Count(Intersect(Row(f={a}), Row(f={b}), Row(f={c})))"
+        for a, b, c in [(0, 1, 2), (3, 4, 5), (1, 3, 5), (0, 2, 4)]
+    )
+    res = ex.execute("i", q)
+    assert astbatch.launches == before + 1  # 4 Counts, ONE launch
+    assert len(res) == 4 and any(r >= 0 for r in res)
+
+
+def test_union4_bitmap_is_one_launch(setup):
+    _, ex = setup
+    ex.execute("i", "Union(Row(f=0), Row(f=1))" * 2)  # warm stack
+    before = astbatch.launches
+    res = ex.execute("i", "Union(Row(f=0), Row(f=1), Row(f=2), Row(f=3))")
+    assert astbatch.launches == before + 1
+    assert res[0].count() > 0
+
+
+def test_shape_cache_reuses_programs(setup):
+    _, ex = setup
+    ex.execute("i", "Count(Intersect(Row(f=0), Row(f=1), Row(f=2)))" * 2)
+    info_before = astbatch.compiled.cache_info()
+    # same shape, different rows: no new compile entry
+    ex.execute("i", "Count(Intersect(Row(f=3), Row(f=1), Row(f=5)))" * 2)
+    info_after = astbatch.compiled.cache_info()
+    assert info_after.misses == info_before.misses
+    assert info_after.hits > info_before.hits
+
+
+def test_cold_single_call_stays_on_segment_path(setup):
+    h, ex = setup
+    # a field the batcher has never stacked, one lone call -> must not
+    # engage (stack builds are full-field uploads)
+    idx = h.index("i")
+    idx.create_field("lonely")
+    ex.execute("i", "Set(7, lonely=0)")
+    before = astbatch.launches
+    res = ex.execute("i", "Union(Row(lonely=0), Row(lonely=0))")
+    assert astbatch.launches == before
+    assert res[0].count() == 1
+
+
+def test_write_barrier_blocks_batching(setup):
+    h, ex = setup
+    before = astbatch.launches
+    # the Count AFTER the write must observe the write; batch path would
+    # observe pre-write state, so it must not engage past the barrier
+    res = ex.execute(
+        "i",
+        "Set(1048570, f=0)"
+        "Count(Union(Row(f=0), Row(f=1), Row(f=2)))"
+        "Count(Union(Row(f=0), Row(f=1), Row(f=2)))",
+    )
+    want = _fresh_executor(h).execute(
+        "i", "Count(Union(Row(f=0), Row(f=1), Row(f=2)))"
+    )
+    assert res[1] == res[2] == want[0]
+
+
+def test_mixed_count_and_bitmap_share_stacks(setup):
+    h, ex = setup
+    q = (
+        "Count(Intersect(Row(f=0), Row(f=1), Row(g=0)))"
+        "Union(Row(f=0), Row(g=1), Row(g=2))"
+        "Count(Intersect(Row(f=2), Row(f=3), Row(g=1)))"
+    )
+    got = ex.execute("i", q)
+    want = _fresh_executor(h).execute("i", q)
+    assert got[0] == want[0] and got[2] == want[2]
+    assert sorted(got[1].columns().tolist()) == sorted(
+        want[1].columns().tolist()
+    )
