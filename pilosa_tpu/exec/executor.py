@@ -115,6 +115,8 @@ class Executor:
         # replace full re-uploads on write-interleaved workloads)
         self.stack_rebuilds = 0
         self.stack_incremental = 0
+        # stacked-BSI launches (tests assert O(1) dispatch per BSI query)
+        self.bsi_stack_launches = 0
 
     # ------------------------------------------------------------------ API
 
@@ -197,9 +199,15 @@ class Executor:
     # stamps only compare within one field's cache dict)
     _stack_lru_clock = itertools.count()
 
-    def _field_stack(self, field: Field, shards: list[int]):
-        """(slot_of, bits[S, R, W] device tensor) for the field's standard
-        view, DENSE over ``shards`` (all-zero slices where a shard has no
+    def _field_stack(
+        self,
+        field: Field,
+        shards: list[int],
+        view_name: str = VIEW_STANDARD,
+        fixed_rows: range | None = None,
+    ):
+        """(slot_of, bits[S, R, W] device tensor) for one of the field's
+        views, DENSE over ``shards`` (all-zero slices where a shard has no
         fragment, so stacks of different fields share the shard axis —
         the GroupBy cross-field kernel needs that alignment). With more
         than one device visible the stack is laid out over the serving
@@ -207,6 +215,10 @@ class Executor:
         padded to the mesh size — so every batched kernel runs on all
         chips (the reference's shard→node mapReduce, executor.go:2454,
         as a static placement).
+
+        ``fixed_rows`` pins the row axis to position-aligned slots (the
+        BSI layout: exists/sign/planes at rows 0..depth+1, reference
+        fragment.go:90-96) instead of the union of observed row ids.
 
         Maintenance is INCREMENTAL: when cached fragment versions drift
         but the row set is unchanged, only the changed shards' row blocks
@@ -217,14 +229,22 @@ class Executor:
         from jax.sharding import NamedSharding, PartitionSpec
         from pilosa_tpu.parallel.mesh import serving_mesh
 
-        v = field.view(VIEW_STANDARD)
+        v = field.view(view_name)
+        if v is None:
+            return None
         frags = {s: v.fragments[s] for s in shards if s in v.fragments}
         if not frags:
             return None
         mesh = serving_mesh()
         # The mesh is part of the key: a device-set/configure_serving
         # change must invalidate stacks built with the old sharding.
-        cache_key = (mesh, tuple(shards))
+        # view + row-axis length too: the standard and BSI stacks of one
+        # field share the cache dict, and a BSI depth autogrow must build
+        # a fresh (wider) stack.
+        cache_key = (
+            mesh, tuple(shards), view_name,
+            len(fixed_rows) if fixed_rows is not None else None,
+        )
         versions = tuple(
             frags[s].version if s in frags else -1 for s in shards
         )
@@ -254,7 +274,12 @@ class Executor:
                 caches.pop(cache_key, None)
                 budget.release(entry["bkey"])
 
-            row_ids = sorted({r for f in frags.values() for r in f.row_ids()})
+            if fixed_rows is not None:
+                row_ids = list(fixed_rows)
+            else:
+                row_ids = sorted(
+                    {r for f in frags.values() for r in f.row_ids()}
+                )
             if not row_ids:
                 return None
             S, R, W = len(shards), len(row_ids), field.n_words
@@ -273,7 +298,9 @@ class Executor:
                 if f is None:
                     continue
                 for r in f.row_ids():
-                    bits[si, slot_of[r]] = f.row_words_host(r)
+                    slot = slot_of.get(r)
+                    if slot is not None:  # fixed_rows: ignore strays
+                        bits[si, slot] = f.row_words_host(r)
             if mesh is not None:
                 dev = jax.device_put(
                     bits,
@@ -282,6 +309,17 @@ class Executor:
             else:
                 dev = jnp.asarray(bits)
             self.stack_rebuilds += 1
+            # a BSI depth autogrow (or a standard view's row-set change)
+            # retires same-(mesh, shards, view) entries with a different
+            # row-axis length — they can never be hit again and would
+            # otherwise strand a full device stack under a dead key
+            for stale in [
+                k for k in caches
+                if k[:3] == cache_key[:3] and k[3] != cache_key[3]
+            ]:
+                old = caches.pop(stale, None)
+                if old is not None:
+                    budget.release(old["bkey"])
             while len(caches) >= self._STACK_CACHE_ENTRIES:
                 # the budget's _evict pops lock-free, so snapshot-scan and
                 # pop with defaults; retry when a concurrent pop races us
@@ -462,7 +500,13 @@ class Executor:
 
     # ------------------------------------------ general AST one-launch path
 
-    def _stack_cached(self, field: Field, shard_list: list[int]) -> bool:
+    def _stack_cached(
+        self,
+        field: Field,
+        shard_list: list[int],
+        view_name: str = VIEW_STANDARD,
+        n_fixed_rows: int | None = None,
+    ) -> bool:
         """Whether a serving stack for this (field, shards) is already
         live — a peek that never builds."""
         from pilosa_tpu.parallel.mesh import serving_mesh
@@ -470,7 +514,9 @@ class Executor:
         caches = getattr(field, "_stack_caches", None)
         if not caches:
             return False
-        return (serving_mesh(), tuple(shard_list)) in caches
+        return (
+            serving_mesh(), tuple(shard_list), view_name, n_fixed_rows
+        ) in caches
 
     def _batch_general(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -988,8 +1034,45 @@ class Executor:
             )
         raise ExecuteError(f"unsupported condition op: {op}")
 
+    def _bsi_stack(self, field: Field, shards: list[int]):
+        """(exists[S, W], sign[S, W], planes[S, depth, W]) device views of
+        the field's stacked BSI planes, or None (no view / over budget).
+        The stack is the same budget-accounted, incrementally-refreshed,
+        mesh-sharded cache as standard-view stacks, with the row axis
+        pinned to the BSI layout (exists=0, sign=1, planes 2.., reference
+        fragment.go:90-96) so every Range/Sum/Min/Max batches all shards
+        into one launch (reference fragment.go:1271-1534 runs the same
+        scan per fragment)."""
+        depth = field.bit_depth
+        stack = self._field_stack(
+            field,
+            shards,
+            view_name=field.bsi_view_name(),
+            fixed_rows=range(2 + depth),
+        )
+        if stack is None:
+            return None
+        _, bits = stack  # [S, depth+2, W]
+        return bits[:, 0], bits[:, 1], bits[:, 2:]
+
     def _bsi_rows(self, field: Field, shards: list[int], kernel) -> Row:
+        """Evaluate a BSI predicate kernel over every shard.  The kernels
+        are shape-polymorphic (ops/bsi.py), so the stacked path runs the
+        SAME compiled scan over [S, depth, W] in one launch; without a
+        stack (over budget) each fragment launches separately."""
         out = Row(n_words=self.holder.n_words)
+        st = self._bsi_stack(field, shards)
+        if st is not None:
+            exists, sign, planes = st
+            self.bsi_stack_launches += 1
+            mask = kernel(planes, exists, sign)  # [S, W], one launch
+            if getattr(mask, "sharding", None) is not None and len(
+                getattr(mask.sharding, "device_set", ())
+            ) > 1:
+                mask = np.asarray(mask)  # one pull; avoid mixed placements
+            for si, s in enumerate(shards):
+                out.segments[s] = mask[si]
+            return out
         view = field.view(field.bsi_view_name())
         if view is None:
             return out
@@ -1028,13 +1111,41 @@ class Executor:
         return field
 
     def _bsi_agg_shards(self, idx: Index, call: Call, shards: list[int] | None):
-        """Shared scaffold for Sum/Min/Max: resolve the BSI field, the
-        optional filter child, and yield per-shard
-        (planes, exists, sign, filter_words) tensors."""
+        """Shared scaffold for Sum/Min/Max: resolve the BSI field and the
+        optional filter child; returns (field, stacked_tensors_or_None,
+        per_shard_generator).  The stacked form — one
+        (planes[S,d,W], exists, sign, filter) tuple covering every shard
+        — answers the aggregate in one launch; the generator is the
+        per-fragment fallback when the stack declines (over budget)."""
         shards = self._shards_for(idx, shards)
         field = self._bsi_field(idx, call)
         filt = self._sum_filter(idx, call, shards)
         view = field.view(field.bsi_view_name())
+
+        stacked = None
+        st = self._bsi_stack(field, shards)
+        if st is not None:
+            exists, sign, planes = st
+            if filt is None:
+                # the kernels compute f = exists & filter, so exists
+                # itself is the identity filter — no index-width upload
+                fw = exists
+            else:
+                # the stack's shard axis is padded to the mesh size;
+                # padded slices have exists == 0, so any filter value
+                # there is inert
+                S_stack = exists.shape[0]
+                fw_np = np.zeros((S_stack, field.n_words), np.uint32)
+                for si, s in enumerate(shards):
+                    seg = filt.segments.get(s)
+                    if seg is not None:
+                        fw_np[si] = np.asarray(seg)
+                sh = getattr(exists, "sharding", None)
+                if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+                    fw = jax.device_put(fw_np, sh)  # co-locate with stack
+                else:
+                    fw = jnp.asarray(fw_np)
+            stacked = (planes, exists, sign, fw)
 
         def per_shard():
             if view is None:
@@ -1052,11 +1163,20 @@ class Executor:
                 planes, exists, sign = frag.bsi_tensors(field.bit_depth)
                 yield planes, exists, sign, fw
 
-        return field, per_shard()
+        return field, stacked, per_shard()
 
     def _execute_sum(self, idx: Index, call: Call, shards: list[int] | None) -> ValCount:
         """reference executor.go:409-442 + executeSumCountShard."""
-        field, tensors = self._bsi_agg_shards(idx, call, shards)
+        field, stacked, tensors = self._bsi_agg_shards(idx, call, shards)
+        if stacked is not None:
+            planes, exists, sign, fw = stacked
+            self.bsi_stack_launches += 1
+            total, count = bsi.sum_host(
+                planes, exists, sign, fw, depth=field.bit_depth
+            )
+            if count == 0:
+                return ValCount()
+            return ValCount(value=total + count * field.base, count=count)
         total, count = 0, 0
         for planes, exists, sign, fw in tensors:
             s, c = bsi.sum_host(planes, exists, sign, fw, depth=field.bit_depth)
@@ -1067,7 +1187,20 @@ class Executor:
         return ValCount(value=total + count * field.base, count=count)
 
     def _execute_min_max(self, idx: Index, call: Call, shards: list[int] | None, maximal: bool) -> ValCount:
-        field, tensors = self._bsi_agg_shards(idx, call, shards)
+        field, stacked, tensors = self._bsi_agg_shards(idx, call, shards)
+        if stacked is not None:
+            # the stacked kernels reduce candidates globally across the
+            # shard axis, which IS the per-shard merge (equal extremes
+            # accumulate their counts)
+            planes, exists, sign, fw = stacked
+            self.bsi_stack_launches += 1
+            value, count = bsi.min_max_host(
+                planes, exists, sign, fw, depth=field.bit_depth,
+                maximal=maximal,
+            )
+            if count == 0:
+                return ValCount()
+            return ValCount(value=value + field.base, count=count)
         best: ValCount | None = None
         for planes, exists, sign, fw in tensors:
             value, count = bsi.min_max_host(

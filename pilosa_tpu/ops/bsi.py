@@ -12,6 +12,12 @@ evaluates the whole scan as an unrolled jitted loop over planes — ``depth``
 is a static Python int (<= 64), so each (op, depth) pair compiles once and
 the plane loop fuses into a handful of vector ops on the VPU.
 
+The kernels are shape-polymorphic over a leading shard axis: pass
+``planes[S, depth, W]`` with ``exists/sign/filter[S, W]`` and the same
+compiled scan serves a whole stacked field in ONE launch (the executor's
+BSI serving stacks), with word-axis reductions kept per shard for
+int32-exactness and Min/Max candidate reductions global across shards.
+
 Values are stored as offset-from-base two's-complement-free sign/magnitude:
 stored = value - base; sign row holds stored < 0; planes hold abs(stored).
 """
@@ -45,7 +51,7 @@ def _select(plane, bit):
 def _range_eq_kernel(planes, exists, sign, bits, oob, *, negative: bool, depth: int):
     b = exists & (sign if negative else ~sign)
     for k in range(depth):
-        b = b & _select(planes[k], bits[k])
+        b = b & _select(planes[..., k, :], bits[k])
     # A bound outside the representable magnitude can equal nothing.
     return jnp.where(oob, jnp.zeros_like(b), b)
 
@@ -64,7 +70,7 @@ def _mag_lt(planes, candidates, bits, oob, depth: int, allow_eq: bool):
     lt = jnp.zeros_like(candidates)
     eq = candidates
     for k in reversed(range(depth)):
-        p = planes[k]
+        p = planes[..., k, :]
         lt = lt | jnp.where(bits[k] == 1, eq & ~p, jnp.zeros_like(eq))
         eq = eq & _select(p, bits[k])
     out = (lt | eq) if allow_eq else lt
@@ -77,7 +83,7 @@ def _mag_gt(planes, candidates, bits, oob, depth: int, allow_eq: bool):
     gt = jnp.zeros_like(candidates)
     eq = candidates
     for k in reversed(range(depth)):
-        p = planes[k]
+        p = planes[..., k, :]
         gt = gt | jnp.where(bits[k] == 1, jnp.zeros_like(eq), eq & p)
         eq = eq & _select(p, bits[k])
     out = (gt | eq) if allow_eq else gt
@@ -149,10 +155,16 @@ def sum_count(planes, exists, sign, filter_words, *, depth: int):
     pos_counts = []
     neg_counts = []
     for k in range(depth):
-        p = planes[k]
-        pos_counts.append(jnp.sum(lax.population_count(p & pos).astype(jnp.int32)))
-        neg_counts.append(jnp.sum(lax.population_count(p & neg).astype(jnp.int32)))
-    count = jnp.sum(lax.population_count(f).astype(jnp.int32))
+        p = planes[..., k, :]
+        # per-(leading-dim) word-axis sums stay int32-exact (<= W*32 per
+        # shard); the host combines them in arbitrary precision
+        pos_counts.append(
+            jnp.sum(lax.population_count(p & pos).astype(jnp.int32), axis=-1)
+        )
+        neg_counts.append(
+            jnp.sum(lax.population_count(p & neg).astype(jnp.int32), axis=-1)
+        )
+    count = jnp.sum(lax.population_count(f).astype(jnp.int32), axis=-1)
     return (
         jnp.stack(pos_counts) if depth else jnp.zeros((0,), jnp.int32),
         jnp.stack(neg_counts) if depth else jnp.zeros((0,), jnp.int32),
@@ -163,13 +175,15 @@ def sum_count(planes, exists, sign, filter_words, *, depth: int):
 def sum_host(planes, exists, sign, filter_words, *, depth: int) -> tuple[int, int]:
     """Host wrapper: exact arbitrary-precision (sum, count) from the
     per-plane device popcounts."""
+    import numpy as np
+
     pos_c, neg_c, count = sum_count(planes, exists, sign, filter_words, depth=depth)
-    pos_c = [int(x) for x in pos_c]
-    neg_c = [int(x) for x in neg_c]
+    pos_c = [int(np.asarray(x).astype(np.int64).sum()) for x in pos_c]
+    neg_c = [int(np.asarray(x).astype(np.int64).sum()) for x in neg_c]
     total = sum(c << k for k, c in enumerate(pos_c)) - sum(
         c << k for k, c in enumerate(neg_c)
     )
-    return total, int(count)
+    return total, int(np.asarray(count).astype(np.int64).sum())
 
 
 @partial(jax.jit, static_argnames=("depth", "maximal"))
@@ -180,7 +194,7 @@ def extreme_mag(planes, candidates, *, depth: int, maximal: bool):
     mag = jnp.zeros((), jnp.int32)
     nonempty = jnp.any(candidates != 0)
     for k in reversed(range(depth)):
-        p = planes[k]
+        p = planes[..., k, :]
         hit = c & (p if maximal else ~p)
         any_hit = jnp.any(hit != 0)
         c = jnp.where(any_hit, hit, c)
@@ -228,14 +242,23 @@ def _exact_mag(planes, survivors, depth: int, approx: int) -> int:
     import numpy as np
 
     surv = np.asarray(survivors)
+    s = None
+    if surv.ndim == 2:  # stacked [S, W]: locate one surviving shard first
+        s_idx = np.flatnonzero(surv.any(axis=1))
+        if len(s_idx) == 0:
+            return 0
+        s = int(s_idx[0])
+        surv = surv[s]
     idx = np.flatnonzero(np.unpackbits(surv.view(np.uint8), bitorder="little"))
     if len(idx) == 0:
         return 0
     col = int(idx[0])
     w, b = col >> 5, col & 31
-    pl = np.asarray(planes)
+    # slice the one surviving column's plane words device-side — pulling
+    # the whole planes tensor would transfer the full field per query
+    pl_col = np.asarray(planes[s, :, w] if s is not None else planes[:, w])
     mag = 0
     for k in range(depth):
-        if (int(pl[k, w]) >> b) & 1:
+        if (int(pl_col[k]) >> b) & 1:
             mag |= 1 << k
     return mag

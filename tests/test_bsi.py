@@ -171,3 +171,118 @@ def test_extreme_mag_empty_candidates(data):
         mag, c = bsi.extreme_mag(planes, zeros, depth=DEPTH, maximal=maximal)
         assert int(mag) == 0
         assert not np.asarray(c).any()
+
+
+# ---------------------------------------------------------------------------
+# BSI serving stacks: one launch per Range/Sum/Min/Max across all shards
+# ---------------------------------------------------------------------------
+
+
+class TestBSIStacks:
+    @pytest.fixture()
+    def ex3(self):
+        """An int field spread over 3 shards with positive and negative
+        values."""
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+        from pilosa_tpu.core.field import FieldOptions
+
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field(
+            "v", FieldOptions(field_type="int", min_=-1000, max_=1000)
+        )
+        ex = Executor(h)
+        rng = np.random.default_rng(17)
+        self.vals = {}
+        width = h.n_words * 32
+        for col in rng.choice(3 * width, size=200, replace=False):
+            v = int(rng.integers(-1000, 1000))
+            self.vals[int(col)] = v
+            ex.execute("i", f"Set({int(col)}, v={v})")
+        return h, ex
+
+    def test_range_is_one_launch_and_exact(self, ex3):
+        _, ex = ex3
+        before = ex.bsi_stack_launches
+        res = ex.execute("i", "Range(v < 250)")[0]
+        assert ex.bsi_stack_launches == before + 1
+        want = {c for c, v in self.vals.items() if v < 250}
+        assert set(res.columns().tolist()) == want
+
+    def test_aggregates_one_launch_each_and_exact(self, ex3):
+        from pilosa_tpu.exec.result import ValCount
+
+        _, ex = ex3
+        before = ex.bsi_stack_launches
+        s, mn, mx = ex.execute("i", "Sum(field=v)Min(field=v)Max(field=v)")
+        assert ex.bsi_stack_launches == before + 3
+        assert s.value == sum(self.vals.values())
+        assert s.count == len(self.vals)
+        lo, hi = min(self.vals.values()), max(self.vals.values())
+        assert mn == ValCount(
+            value=lo, count=sum(1 for v in self.vals.values() if v == lo)
+        )
+        assert mx == ValCount(
+            value=hi, count=sum(1 for v in self.vals.values() if v == hi)
+        )
+
+    def test_filtered_sum_matches_fallback(self, ex3):
+        _, ex = ex3
+        idx_obj = ex.holder.index("i")
+        idx_obj.create_field("tag")
+        cols = sorted(self.vals)[:40]
+        ex.execute("i", " ".join(f"Set({c}, tag=1)" for c in cols))
+        got = ex.execute("i", "Sum(Row(tag=1), field=v)")[0]
+        # fallback path: stack disabled
+        ex2 = type(ex)(ex.holder)
+        ex2._bsi_stack = lambda *a, **k: None
+        want = ex2.execute("i", "Sum(Row(tag=1), field=v)")[0]
+        assert got == want
+        assert got.value == sum(self.vals[c] for c in cols)
+
+    def test_stack_declines_over_budget_falls_back(self, ex3, monkeypatch):
+        import pilosa_tpu.exec.executor as exmod
+
+        _, ex = ex3
+        monkeypatch.setattr(exmod, "_STACK_BUDGET_BYTES", 0)
+        # fresh field dict: drop any cached stack
+        idx_obj = ex.holder.index("i")
+        f = idx_obj.field("v")
+        if hasattr(f, "_stack_caches"):
+            f._stack_caches.clear()
+        res = ex.execute("i", "Range(v >= 250)")[0]
+        want = {c for c, v in self.vals.items() if v >= 250}
+        assert set(res.columns().tolist()) == want
+
+    def test_incremental_refresh_after_write(self, ex3):
+        _, ex = ex3
+        ex.execute("i", "Range(v < 0)")  # build stack
+        ex.execute("i", "Set(5, v=-7)")
+        self.vals[5] = -7
+        res = ex.execute("i", "Range(v < 0)")[0]
+        want = {c for c, v in self.vals.items() if v < 0}
+        assert set(res.columns().tolist()) == want
+
+    def test_depth_autogrow_purges_stale_stack(self, ex3):
+        """The old-depth device stack must be released when autogrow
+        re-keys the cache — not stranded under a dead key."""
+        from pilosa_tpu.core.field import FieldOptions
+
+        _, ex = ex3
+        # an unbounded int field: bit_depth starts at observed values and
+        # grows (reference field.go:1050-1067)
+        ex.holder.index("i").create_field(
+            "w", FieldOptions(field_type="int")
+        )
+        f = ex.holder.index("i").field("w")
+        f.import_values([1, 2], [3, 7])  # depth grows to observed values
+        ex.execute("i", "Range(w < 5)")  # build stack at small depth
+        keys_before = set(f._stack_caches)
+        f.import_values([3], [100000])  # depth grows (reference
+        # field.go:1050-1067 bitDepth autogrow on import)
+        res = ex.execute("i", "Range(w < 5)")[0]  # rebuild at grown depth
+        assert set(res.columns().tolist()) == {1}
+        bsi_keys = [k for k in f._stack_caches if k[3] is not None]
+        assert len(bsi_keys) == 1  # old-depth entry purged
+        assert bsi_keys[0] not in keys_before
