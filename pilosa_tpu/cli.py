@@ -26,6 +26,10 @@ DEFAULT_CONFIG = {
     "data-dir": "~/.pilosa-tpu",
     "bind": "localhost:10101",
     "long-query-time": 0.0,
+    # null = auto (80% of the accelerator's bytes_limit on TPU, unlimited
+    # accounting on CPU — core/membudget.py); 0 = force unlimited
+    # accounting; >0 = explicit cap in bytes
+    "hbm-budget-bytes": None,
     "cluster": {"replicas": 1, "coordinator": True, "hosts": []},
     "anti-entropy": {"interval": 600},
     "metric": {"service": "none", "poll-interval": 60, "diagnostics-sink": ""},
@@ -48,6 +52,7 @@ def _load_config(path: str | None) -> dict:
         "PILOSA_TPU_DATA_DIR": ("data-dir",),
         "PILOSA_TPU_BIND": ("bind",),
         "PILOSA_TPU_LONG_QUERY_TIME": ("long-query-time",),
+        "PILOSA_TPU_HBM_BUDGET_BYTES": ("hbm-budget-bytes",),
     }
     for env, keys in env_map.items():
         if env in os.environ:
@@ -90,6 +95,18 @@ def cmd_server(args) -> int:
     bind = args.bind or cfg["bind"]
     host, _, port = bind.rpartition(":")
     host = host or "localhost"
+
+    # HBM budget precedence: flag > env/config > auto-probe at first use
+    # (membudget.default_budget).  Explicit 0 on ANY channel forces
+    # unlimited accounting; absence means auto.
+    from pilosa_tpu.core import membudget
+
+    hbm = args.hbm_budget
+    if hbm is None:
+        raw = cfg.get("hbm-budget-bytes")
+        hbm = int(raw) if raw is not None else None
+    if hbm is not None:
+        membudget.configure(hbm or None)
 
     # metric.service selects the backend (reference server.go:397-411);
     # "none" keeps the zero-cost nop client.
@@ -264,6 +281,13 @@ def main(argv=None) -> int:
     ps.add_argument("-d", "--data-dir", default=None)
     ps.add_argument("-b", "--bind", default=None)
     ps.add_argument("-c", "--config", default=None)
+    ps.add_argument(
+        "--hbm-budget",
+        type=int,
+        default=None,
+        help="HBM budget in bytes for device-resident fragment/stack "
+        "copies (default: 80%% of the accelerator's memory limit)",
+    )
     ps.add_argument("--tls-cert", default=None, help="TLS certificate path (enables HTTPS)")
     ps.add_argument("--tls-key", default=None, help="TLS private key path")
     ps.add_argument(

@@ -304,6 +304,18 @@ class Fragment:
                 return False
             return bool((int(self._host[s, col >> 5]) >> (col & 31)) & 1)
 
+    def rows_with_column(self, col: int) -> list[int]:
+        """Row ids containing this column — one vectorized pass over the
+        host mirror's column word (the Rows(column=...) filter; reference
+        fragment.go:2612-2657 filterColumn, without per-row get_bit)."""
+        with self._lock:
+            n = len(self._rowids)
+            if n == 0:
+                return []
+            w, b = col >> 5, np.uint32(col & 31)
+            mask = (self._host[:n, w] >> b) & np.uint32(1)
+            return [self._rowids[s] for s in np.flatnonzero(mask)]
+
     def set_row_words(self, row: int, words: np.ndarray) -> bool:
         """Replace a whole row (reference fragment.go:781-834 setRow);
         returns True if the row changed."""
@@ -662,12 +674,20 @@ class Fragment:
             return mag, True
 
     def clear_value(self, col: int) -> bool:
-        """Remove a column's BSI value entirely."""
+        """Remove a column's BSI value entirely — one masked pass over
+        the plane rows' column word instead of a per-row clear_bit loop."""
         with self._lock, self._batched_store():
-            if not self.get_bit(BSI_EXISTS_BIT, col):
+            s_exists = self._slot_of.get(BSI_EXISTS_BIT)
+            w, bmask = col >> 5, np.uint32(1 << (col & 31))
+            if s_exists is None or not self._host[s_exists, w] & bmask:
                 return False
-            for row in list(self._slot_of):
-                self.clear_bit(row, col)
+            n = len(self._rowids)
+            set_slots = np.flatnonzero(self._host[:n, w] & bmask)
+            self._host[set_slots, w] &= ~bmask
+            for s in set_slots.tolist():
+                self._touch(int(s))
+                if self.store is not None:
+                    self.store.log_remove(self._rowids[s], col)
             return True
 
     def import_values(self, cols: np.ndarray, values: np.ndarray, bit_depth: int, clear: bool = False) -> None:

@@ -97,14 +97,46 @@ class DeviceBudget:
 _default: DeviceBudget | None = None
 _default_lock = threading.Lock()
 
+# Fraction of the accelerator's reported bytes_limit used when no explicit
+# cap is configured: stacks/fragments may not squat on ALL of HBM — XLA
+# needs headroom for program temporaries (gram staging, scan buffers).
+DEFAULT_HBM_FRACTION = 0.8
+
+
+def _probe_device_cap() -> int | None:
+    """Derive a default cap from the local accelerator's memory stats
+    (reference ships working syswrap defaults — 60k maps,
+    syswrap/mmap.go — rather than unlimited).  None on CPU backends or
+    when the runtime exposes no stats."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        if dev.platform == "cpu":
+            return None
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if not limit:
+            return None
+        return int(limit * DEFAULT_HBM_FRACTION)
+    except Exception:
+        return None
+
 
 def default_budget() -> DeviceBudget:
-    """The process-wide budget.  Cap comes from PILOSA_TPU_HBM_BUDGET_BYTES
-    (unset/0 = unlimited accounting)."""
+    """The process-wide budget.  Cap precedence: explicit
+    PILOSA_TPU_HBM_BUDGET_BYTES (0 = force unlimited accounting), else
+    80% of the accelerator's ``bytes_limit`` (a real v5e would OOM on
+    device allocations long before an unlimited LRU ever engaged), else
+    unlimited on CPU."""
     global _default
     with _default_lock:
         if _default is None:
-            cap = int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", "0")) or None
+            env = os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES")
+            if env is not None:
+                cap = int(env) or None
+            else:
+                cap = _probe_device_cap()
             _default = DeviceBudget(cap)
         return _default
 

@@ -1233,16 +1233,20 @@ class Executor:
                 if frag is None:
                     continue
                 ids, counts = frag.row_counts()
-                for rid, cnt in zip(ids, counts.tolist()):
-                    if cnt == 0:
-                        continue
-                    if (
-                        best is None
-                        or (rid > best.id if maximal else rid < best.id)
-                    ):
-                        best = Pair(id=rid, count=cnt)
-                    elif rid == best.id:
-                        best.count += cnt
+                # uint64: row ids span the full 64-bit space
+                ids = np.asarray(ids, np.uint64)
+                counts = np.asarray(counts, np.int64)
+                nz = counts > 0  # vectorized extreme instead of a
+                if not nz.any():  # per-row Python scan
+                    continue
+                rid = int(ids[nz].max() if maximal else ids[nz].min())
+                cnt = int(counts[ids == rid][0])
+                if best is None or (
+                    rid > best.id if maximal else rid < best.id
+                ):
+                    best = Pair(id=rid, count=cnt)
+                elif rid == best.id:
+                    best.count += cnt
         return best or Pair()
 
     # ------------------------------------------------------------- mutations
@@ -1536,7 +1540,7 @@ class Executor:
             col = self._maybe_translate_col(idx, col)
             shard = col // (field.n_words * 32)
             off = col % (field.n_words * 32)
-            kept = []
+            present: set[int] = set()
             for vname in [VIEW_STANDARD] if views is None else views:
                 v = field.view(vname)
                 if v is None:
@@ -1544,8 +1548,9 @@ class Executor:
                 frag = v.fragment(shard)
                 if frag is None:
                     continue
-                kept.extend(r for r in ids if frag.get_bit(r, off))
-            ids = sorted(set(kept))
+                # one column-word gather per fragment, no per-row get_bit
+                present.update(frag.rows_with_column(off))
+            ids = sorted(set(ids) & present)
 
         prev, has_prev = call.uint_arg("previous")
         if has_prev:

@@ -159,3 +159,68 @@ def test_field_stack_respects_budget_and_evicts(restore_budget):
     membudget.configure(1024)
     field._stack_caches = {}
     assert ex._field_stack(field, shards) is None
+
+
+# ---------------------------------------------------------------------------
+# Default cap derivation from accelerator memory stats
+# ---------------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, platform, stats):
+        self.platform = platform
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_default_cap_derived_from_tpu_memory_stats(monkeypatch):
+    import pilosa_tpu.core.membudget as mb
+
+    monkeypatch.delenv("PILOSA_TPU_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.setattr(
+        "jax.local_devices",
+        lambda: [_FakeDev("tpu", {"bytes_limit": 10_000_000_000})],
+    )
+    monkeypatch.setattr(mb, "_default", None)
+    b = mb.default_budget()
+    assert b.cap == int(10_000_000_000 * mb.DEFAULT_HBM_FRACTION)
+
+
+def test_default_cap_unlimited_on_cpu(monkeypatch):
+    import pilosa_tpu.core.membudget as mb
+
+    monkeypatch.delenv("PILOSA_TPU_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.setattr("jax.local_devices", lambda: [_FakeDev("cpu", {})])
+    monkeypatch.setattr(mb, "_default", None)
+    assert mb.default_budget().cap is None
+
+
+def test_env_zero_forces_unlimited_even_on_tpu(monkeypatch):
+    import pilosa_tpu.core.membudget as mb
+
+    monkeypatch.setenv("PILOSA_TPU_HBM_BUDGET_BYTES", "0")
+    monkeypatch.setattr(
+        "jax.local_devices",
+        lambda: [_FakeDev("tpu", {"bytes_limit": 10_000_000_000})],
+    )
+    monkeypatch.setattr(mb, "_default", None)
+    assert mb.default_budget().cap is None
+
+
+def test_env_explicit_cap_wins(monkeypatch):
+    import pilosa_tpu.core.membudget as mb
+
+    monkeypatch.setenv("PILOSA_TPU_HBM_BUDGET_BYTES", "12345678")
+    monkeypatch.setattr(mb, "_default", None)
+    assert mb.default_budget().cap == 12345678
+
+
+def test_probe_survives_missing_stats(monkeypatch):
+    import pilosa_tpu.core.membudget as mb
+
+    monkeypatch.delenv("PILOSA_TPU_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.setattr("jax.local_devices", lambda: [_FakeDev("tpu", None)])
+    monkeypatch.setattr(mb, "_default", None)
+    assert mb.default_budget().cap is None
