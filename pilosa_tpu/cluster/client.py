@@ -14,6 +14,8 @@ import urllib.error
 import urllib.request
 from typing import Any
 
+import numpy as np
+
 from pilosa_tpu.obs import tracing
 
 
@@ -118,8 +120,29 @@ class InternalClient:
     # -- imports (reference http/client.go Import/ImportRoaring) ------------
 
     def import_bits(self, uri: str, index: str, field: str, req: dict) -> None:
+        """Forward an import slice.  Translated id batches travel as
+        packed roaring/array blobs (cluster/wire.py encode_import — the
+        reference protobuf-encodes every import, proto.go); key-carrying
+        or timestamped requests fall back to JSON."""
+        from pilosa_tpu.cluster import wire
+
+        body = wire.encode_import(dict(req, remote=True))
+        if body is not None:
+            self._do(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/import",
+                body,
+                content_type="application/octet-stream",
+            )
+            return
+        jr = {
+            k: (v.tolist() if isinstance(v, np.ndarray) else v)
+            for k, v in req.items()
+            if not k.startswith("_")
+        }
         self._json(
-            "POST", uri, f"/index/{index}/field/{field}/import", dict(req, remote=True)
+            "POST", uri, f"/index/{index}/field/{field}/import", dict(jr, remote=True)
         )
 
     def import_roaring(

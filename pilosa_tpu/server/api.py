@@ -419,14 +419,18 @@ class API:
         # replica can't leave later nodes' slices silently undelivered.
         errors: list[str] = []
         for node_id, mask in node_masks.items():
+            # numpy slices ride through: the local apply consumes them
+            # directly and the client binary-encodes them (JSON fallback
+            # listifies; "_width" lets it build roaring positions)
             sub: dict = {
-                "columnIDs": [int(c) for c in cols[mask]],
+                "columnIDs": cols[mask],
                 "remote": True,
+                "_width": width,
             }
             if values is not None:
-                sub["values"] = [int(v) for v in values[mask]]
+                sub["values"] = values[mask]
             else:
-                sub["rowIDs"] = [int(r) for r in rows[mask]]
+                sub["rowIDs"] = rows[mask]
             if timestamps is not None:
                 idxs = np.nonzero(mask)[0]
                 sub["timestamps"] = [timestamps[i] for i in idxs]

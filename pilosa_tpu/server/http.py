@@ -278,7 +278,14 @@ class Handler(BaseHTTPRequestHandler):
         self._send_json(200, {})
 
     def r_import_(self, index: str, field: str):
-        self.api.import_bits(index, field, self._json_body())
+        ctype = self.headers.get("Content-Type", "")
+        if ctype.startswith("application/octet-stream"):
+            from pilosa_tpu.cluster import wire
+
+            req = wire.decode_import(self._body())
+        else:
+            req = self._json_body()
+        self.api.import_bits(index, field, req)
         self._send_json(200, {})
 
     def r_import_roaring(self, index: str, field: str, shard: str):
