@@ -31,6 +31,8 @@ DEFAULT_CONFIG = {
     # accounting; >0 = explicit cap in bytes
     "hbm-budget-bytes": None,
     "cluster": {"replicas": 1, "coordinator": True, "hosts": []},
+    # reference api.go:66-96 importWorkerPoolSize (default 2)
+    "import": {"workers": 2, "queue-depth": 16},
     "anti-entropy": {"interval": 600},
     "metric": {"service": "none", "poll-interval": 60, "diagnostics-sink": ""},
     "tracing": {"enabled": False},
@@ -127,6 +129,8 @@ def cmd_server(args) -> int:
         tls_ca_cert=getattr(args, "tls_ca_cert", None)
         or tls_cfg.get("ca-certificate")
         or None,
+        import_workers=int(cfg.get("import", {}).get("workers", 2)),
+        import_queue_depth=int(cfg.get("import", {}).get("queue-depth", 16)),
     )
     # tracing exporter + sampler (reference tracing config
     # server/config.go:139-145)

@@ -208,3 +208,32 @@ def time_of_view(view: str, adj: bool) -> datetime | None:
         t = datetime.strptime(part, "%Y%m%d%H")
         return t + timedelta(hours=1) if adj else t
     raise ValueError(f"invalid time format on view: {view}")
+
+
+def view_cover(field, from_arg, to_arg, standard_name: str) -> list[str] | None:
+    """The minimal time-view cover of [from, to] for a field, clamping a
+    missing bound to the field's existing time views (reference
+    executor.go:1376-1397 + time.go viewsByTimeRange).  None when a bound
+    is missing and no time views exist (the range is provably empty).
+    Raises ValueError when the field has no time quantum."""
+    q = field.options.time_quantum
+    if not q:
+        raise ValueError(
+            f"field {field.name!r} has no time quantum for time range"
+        )
+    start = parse_time(from_arg) if from_arg is not None else None
+    end = parse_time(to_arg) if to_arg is not None else None
+    if start is None or end is None:
+        time_views = [
+            v for v in field.views if v.startswith(standard_name + "_")
+        ]
+        lo_v, hi_v = min_max_views(time_views, q)
+        if start is None:
+            if not lo_v:
+                return None
+            start = time_of_view(lo_v, False)
+        if end is None:
+            if not hi_v:
+                return None
+            end = time_of_view(hi_v, True)
+    return views_by_time_range(standard_name, start, end, q)

@@ -19,6 +19,11 @@ program per query shape, cached"):
 * ``Not`` is rewritten at match time into
   ``Difference(Row(_exists=0), child)`` — the reference's executeNot
   (executor.go) against the existence field, as a plain tree node.
+* A time-range ``Row(f=v, from=..., to=...)`` expands into a Union of
+  per-view leaves over the minimal time-view cover (reference
+  executor.go:1515-1531; the reference treats time views as ordinary
+  fragments, view.go:33-38) — so time-quantum queries ride the same
+  compiled one-launch programs, with one cached stack per (field, view).
 
 Launches are counted in :data:`launches` so tests can assert O(1)
 dispatch per query batch regardless of shard count or tree width.
@@ -35,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.pql.ast import Call
@@ -50,37 +56,66 @@ _OPS = {
     "Xor": "xor",
 }
 
-# sig nodes: ("row", field_name) | (op, *child_sigs)
+# Largest time-view cover a range leaf may expand to: past this, the
+# per-view stack builds and the unrolled leaf gathers cost more than the
+# segment path's plain union (a fine quantum over a wide window can
+# cover thousands of views).
+MAX_TIME_COVER = 16
+
+# sig nodes: ("row", field_name, view_name) | (op, *child_sigs)
 
 
 def _stackable_field(idx, fname: str):
-    """The field when its standard view can serve stacked reads."""
+    """The field when it can serve stacked reads at all (per-view
+    existence is checked by the stack builder; an absent view is an
+    all-zero leaf)."""
     if fname is None:
         return None
     field = idx.field(fname)
     if field is None or field.field_type == FIELD_TYPE_INT:
         return None
-    if field.view(VIEW_STANDARD) is None:
-        return None
     return field
 
 
-def match_tree(idx, call: Call, leaves: list[tuple[str, int]]):
-    """``sig`` for a batchable bitmap tree, appending its (field, row)
-    leaves in traversal order; None when any node falls outside the
-    compilable set (BSI conditions, time ranges, Shift, keyed rows...).
-    """
+def match_tree(idx, call: Call, leaves: list[tuple[str, str, int]]):
+    """``sig`` for a batchable bitmap tree, appending its
+    (field, view, row) leaves in traversal order; None when any node
+    falls outside the compilable set (BSI conditions, Shift, keyed
+    rows...)."""
     name = call.name
     if name == "Row":
         fname = call.field_arg()
         field = _stackable_field(idx, fname)
-        if field is None or set(call.args) != {fname} or call.children:
+        if field is None or call.children:
             return None
         v = call.args.get(fname)
         if not isinstance(v, int) or isinstance(v, bool):
             return None
-        leaves.append((fname, v))
-        return ("row", fname)
+        if "from" in call.args or "to" in call.args:
+            # time range -> Union over the minimal view cover
+            if set(call.args) - {fname, "from", "to"}:
+                return None
+            try:
+                cover = timequantum.view_cover(
+                    field, call.args.get("from"), call.args.get("to"),
+                    VIEW_STANDARD,
+                )
+            except ValueError:
+                return None
+            if not cover or len(cover) > MAX_TIME_COVER:
+                # empty range (segment path is free) or a cover so wide
+                # that unrolled leaves/stacks would cost more than the
+                # per-fragment union
+                return None
+            for vname in cover:
+                leaves.append((fname, vname, v))
+            return ("union", *[("row", fname, vn) for vn in cover])
+        if set(call.args) != {fname}:
+            return None
+        if field.view(VIEW_STANDARD) is None:
+            return None
+        leaves.append((fname, VIEW_STANDARD, v))
+        return ("row", fname, VIEW_STANDARD)
     if name == "Not":
         # executeNot: exists-row difference (requires track_existence)
         if len(call.children) != 1 or call.args or not idx.track_existence:
@@ -88,11 +123,11 @@ def match_tree(idx, call: Call, leaves: list[tuple[str, int]]):
         ef = idx.existence_field()
         if ef is None or ef.view(VIEW_STANDARD) is None:
             return None
-        leaves.append((ef.name, 0))
+        leaves.append((ef.name, VIEW_STANDARD, 0))
         child = match_tree(idx, call.children[0], leaves)
         if child is None:
             return None
-        return ("difference", ("row", ef.name), child)
+        return ("difference", ("row", ef.name, VIEW_STANDARD), child)
     op = _OPS.get(name)
     if op is not None:
         if not call.children or call.args:
@@ -107,7 +142,7 @@ def match_tree(idx, call: Call, leaves: list[tuple[str, int]]):
     return None
 
 
-def match_count(idx, call: Call, leaves: list[tuple[str, int]]):
+def match_count(idx, call: Call, leaves: list[tuple[str, str, int]]):
     """sig for ``Count(tree)`` when the tree is compilable and not a bare
     Row (plain row counts are already one gather on the segment path)."""
     if call.name != "Count" or len(call.children) != 1 or call.args:
@@ -118,15 +153,15 @@ def match_count(idx, call: Call, leaves: list[tuple[str, int]]):
     return match_tree(idx, child, leaves)
 
 
-def sig_fields(sig) -> tuple[str, ...]:
-    """Distinct leaf fields in first-appearance order — the compiled
-    program's stack-argument order."""
-    out: list[str] = []
+def sig_fields(sig) -> tuple[tuple[str, str], ...]:
+    """Distinct leaf (field, view) pairs in first-appearance order — the
+    compiled program's stack-argument order."""
+    out: list[tuple[str, str]] = []
 
     def walk(s):
         if s[0] == "row":
-            if s[1] not in out:
-                out.append(s[1])
+            if (s[1], s[2]) not in out:
+                out.append((s[1], s[2]))
             return
         for k in s[1:]:
             walk(k)
@@ -141,7 +176,7 @@ def _build(sig, findex: dict[str, int], ctr: list[int]):
     if sig[0] == "row":
         li = ctr[0]
         ctr[0] += 1
-        fi = findex[sig[1]]
+        fi = findex[(sig[1], sig[2])]
 
         def leaf(stacks, slots, li=li, fi=fi):
             s = slots[li]

@@ -540,7 +540,7 @@ class Executor:
         for i, call in enumerate(calls):
             if results[i] is not _UNSET:
                 continue
-            leaves: list[tuple[str, int]] = []
+            leaves: list[tuple[str, str, int]] = []
             sig = astbatch.match_count(idx, call, leaves)
             if sig is not None:
                 count_groups.setdefault(sig, []).append((i, leaves))
@@ -552,44 +552,62 @@ class Executor:
                 bitmap_items.append((i, sig, leaves))
             else:
                 continue
-            for f in astbatch.sig_fields(sig):
-                demand[f] = demand.get(f, 0) + 1
+            for pair in astbatch.sig_fields(sig):
+                demand[pair] = demand.get(pair, 0) + 1
         if not count_groups and not bitmap_items:
             return
         shard_list = self._shards_for(idx, shards)
 
-        stacks_by_field: dict[str, Any] = {}
+        # (field, view) -> stack entry | None (declined) | _ABSENT (no
+        # such view: an all-zero leaf, e.g. an empty period of a
+        # time-range cover)
+        _ABSENT = object()
+        stacks_by_view: dict[tuple[str, str], Any] = {}
 
         def _stacks_for(sig):
-            """(stacks tuple, slot_of per field) or None when any field
-            declines (cold + under-demanded, or over budget)."""
-            fields = astbatch.sig_fields(sig)
-            out = []
+            """(stacks tuple, slot_of per (field, view)) or None when any
+            leaf declines (cold + under-demanded, or over budget)."""
+            pairs = astbatch.sig_fields(sig)
+            out: list[Any] = []
             slot_maps = {}
-            for fname in fields:
-                if fname not in stacks_by_field:
+            for pair in pairs:
+                fname, vname = pair
+                if pair not in stacks_by_view:
                     field = idx.field(fname)  # includes _exists
                     if field is None:
-                        stacks_by_field[fname] = None
-                    elif demand.get(fname, 0) >= 2 or self._stack_cached(
-                        field, shard_list
+                        stacks_by_view[pair] = None
+                    elif field.view(vname) is None:
+                        stacks_by_view[pair] = _ABSENT
+                    elif demand.get(pair, 0) >= 2 or self._stack_cached(
+                        field, shard_list, vname
                     ):
-                        stacks_by_field[fname] = self._field_stack(
-                            field, shard_list
+                        stacks_by_view[pair] = self._field_stack(
+                            field, shard_list, view_name=vname
                         )
                     else:
-                        stacks_by_field[fname] = None
-                entry = stacks_by_field[fname]
+                        stacks_by_view[pair] = None
+                entry = stacks_by_view[pair]
                 if entry is None:
                     return None
-                slot_maps[fname] = entry[0]
-                out.append(entry[1])
-            return tuple(out), slot_maps
+                if entry is _ABSENT:
+                    slot_maps[pair] = {}
+                    out.append(None)  # placeholder filled below
+                else:
+                    slot_maps[pair] = entry[0]
+                    out.append(entry[1])
+            # absent views still need a stack-shaped input for their
+            # argument position: reuse any real stack — every such
+            # leaf's slot is -1, which masks the gather to zero words
+            real = next((a for a in out if a is not None), None)
+            if real is None:
+                return None  # every leaf view absent
+            return tuple(a if a is not None else real for a in out), slot_maps
 
         def _slots_of(leaves, slot_maps) -> np.ndarray:
             # absent rows -> slot -1 (masked to zero words in the leaf)
             return np.array(
-                [slot_maps[f].get(r, -1) for f, r in leaves], np.int32
+                [slot_maps[(f, vn)].get(r, -1) for f, vn, r in leaves],
+                np.int32,
             )
 
         for sig, items in count_groups.items():
@@ -925,47 +943,23 @@ class Executor:
             )
         return self._field_row(field, v, shards)
 
-    def _time_bounds(self, field: Field, from_arg, to_arg) -> tuple[datetime, datetime] | None:
-        """Resolve (start, end), clamping a missing bound to the field's
-        existing time views via minMaxViews/timeOfView (reference
-        executor.go:1376-1397) — never walking the open-ended calendar.
-        Returns None when a bound is missing and no time views exist."""
-        q = field.options.time_quantum
-        if not q:
-            raise ExecuteError(
-                f"field {field.name!r} has no time quantum for time range"
-            )
-        start = timequantum.parse_time(from_arg) if from_arg is not None else None
-        end = timequantum.parse_time(to_arg) if to_arg is not None else None
-        if start is None or end is None:
-            time_views = [
-                v for v in field.views if v.startswith(VIEW_STANDARD + "_")
-            ]
-            lo_v, hi_v = timequantum.min_max_views(time_views, q)
-            if start is None:
-                if not lo_v:
-                    return None
-                start = timequantum.time_of_view(lo_v, False)
-            if end is None:
-                if not hi_v:
-                    return None
-                end = timequantum.time_of_view(hi_v, True)
-        return start, end
+    def _view_cover(self, field: Field, from_arg, to_arg) -> list[str] | None:
+        try:
+            return timequantum.view_cover(field, from_arg, to_arg, VIEW_STANDARD)
+        except ValueError as e:
+            raise ExecuteError(str(e))
 
     def _execute_time_range(self, idx: Index, field: Field, call: Call, shards: list[int]) -> Row:
         """Union of the minimal time-view cover (reference
         executor.go:1515-1531 + time.go viewsByTimeRange)."""
         fname = field.name
         row_id = call.args.get(fname)
-        bounds = self._time_bounds(
+        views = self._view_cover(
             field, call.args.get("from"), call.args.get("to")
         )
         out = Row(n_words=idx.n_words)
-        if bounds is None:
+        if views is None:
             return out
-        views = timequantum.views_by_time_range(
-            VIEW_STANDARD, bounds[0], bounds[1], field.options.time_quantum
-        )
         for vname in views:
             out = out.union(self._field_row(field, row_id, shards, view=vname))
         return out
@@ -1567,12 +1561,8 @@ class Executor:
         to_arg = call.args.get("to")
         if from_arg is None and to_arg is None:
             return None
-        bounds = self._time_bounds(field, from_arg, to_arg)
-        if bounds is None:
-            return []
-        return timequantum.views_by_time_range(
-            VIEW_STANDARD, bounds[0], bounds[1], field.options.time_quantum
-        )
+        cover = self._view_cover(field, from_arg, to_arg)
+        return [] if cover is None else cover
 
     def _maybe_translate_col(self, idx: Index, col) -> int:
         if isinstance(col, str):

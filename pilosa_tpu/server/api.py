@@ -69,6 +69,8 @@ class API:
         cluster=None,
         client=None,
         broadcaster=None,
+        import_workers: int = 2,
+        import_queue_depth: int = 16,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -92,11 +94,14 @@ class API:
         # server.go diagnostics wiring).
         self.diagnostics = None
         # Bounded import worker pool: concurrency limit + backpressure
-        # (reference api.go:66-96 importWorkerPoolSize=2, importWorker
-        # :313-348).
+        # (reference api.go:66-96 importWorkerPoolSize default 2,
+        # importWorker :313-348; both knobs configurable like the
+        # reference's server config).
         from pilosa_tpu.server.importpool import ImportPool
 
-        self.import_pool = ImportPool(workers=2, depth=16)
+        self.import_pool = ImportPool(
+            workers=import_workers, depth=import_queue_depth
+        )
 
     @property
     def state(self) -> str:

@@ -282,7 +282,12 @@ class Handler(BaseHTTPRequestHandler):
         if ctype.startswith("application/octet-stream"):
             from pilosa_tpu.cluster import wire
 
-            req = wire.decode_import(self._body())
+            try:
+                req = wire.decode_import(self._body())
+            except Exception as e:
+                # malformed client input, not a server fault (the JSON
+                # path 400s the same way via _json_body)
+                raise ApiError(f"bad binary import payload: {e}")
         else:
             req = self._json_body()
         self.api.import_bits(index, field, req)

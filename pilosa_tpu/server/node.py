@@ -40,6 +40,8 @@ class NodeServer:
         tls_key: str | None = None,
         tls_skip_verify: bool = False,
         tls_ca_cert: str | None = None,
+        import_workers: int = 2,
+        import_queue_depth: int = 16,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -67,6 +69,8 @@ class NodeServer:
             cluster=self.cluster,
             client=self.client,
             broadcaster=self.broadcaster,
+            import_workers=import_workers,
+            import_queue_depth=import_queue_depth,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

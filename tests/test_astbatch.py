@@ -152,3 +152,56 @@ def test_mixed_count_and_bitmap_share_stacks(setup):
     assert sorted(got[1].columns().tolist()) == sorted(
         want[1].columns().tolist()
     )
+
+
+class TestTimeRangeBatch:
+    """Time-range Rows expand into per-view union leaves and ride the
+    compiled one-launch path (reference executor.go:1515-1531 treats
+    time views as ordinary fragments)."""
+
+    @pytest.fixture()
+    def ex_time(self, setup):
+        from pilosa_tpu.core.field import FieldOptions
+
+        h, ex = setup
+        h.index("i").create_field(
+            "t", FieldOptions(field_type="time", time_quantum="YMDH")
+        )
+        ex.execute("i", "Set(1, t=9, 2017-01-02T03:00)")
+        ex.execute("i", "Set(2, t=9, 2017-01-02T04:00)")
+        ex.execute("i", "Set(3, t=9, 2017-03-01T00:00)")
+        ex.execute("i", "Set(2, t=5, 2017-01-02T04:00)")
+        return h, ex
+
+    def test_count_time_range_matches_segment_path(self, ex_time):
+        h, ex = ex_time
+        q = (
+            "Count(Union(Row(t=9, from=2017-01-02T00:00, to=2017-01-03T00:00),"
+            " Row(t=5, from=2017-01-01T00:00, to=2017-02-01T00:00)))"
+        ) * 2
+        got = ex.execute("i", q)
+        want = _fresh_executor(h).execute("i", q)
+        assert got == want and got[0] == 2  # cols 1, 2
+
+    def test_time_range_batch_is_one_launch(self, ex_time):
+        _, ex = ex_time
+        q = (
+            "Count(Intersect(Row(t=9, from=2017-01-01T00:00, to=2017-04-01T00:00),"
+            " Row(f=0)))"
+        )
+        ex.execute("i", q * 2)  # warm per-view stacks
+        before = astbatch.launches
+        res = ex.execute("i", q * 3)
+        assert astbatch.launches == before + 1
+        assert len(res) == 3 and res[0] == res[1] == res[2]
+
+    def test_absent_cover_views_are_zero_leaves(self, ex_time):
+        h, ex = ex_time
+        # a window whose cover includes months with no data at all
+        q = (
+            "Count(Union(Row(t=9, from=2017-01-01T00:00, to=2017-06-01T00:00),"
+            " Row(t=9, from=2017-02-01T00:00, to=2017-03-01T00:00)))"
+        ) * 2
+        got = ex.execute("i", q)
+        want = _fresh_executor(h).execute("i", q)
+        assert got == want and got[0] == 3

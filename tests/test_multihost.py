@@ -1,9 +1,14 @@
-"""Two-process jax.distributed smoke test for init_multihost
-(parallel/mesh.py): each process contributes 2 virtual CPU devices, the
-global mesh spans 4, and one sharded query computes the same count every
-process sees — documenting the multi-host story instead of asserting it
-(reference scales hosts via gossip+HTTP, SURVEY §2.4; the TPU-native
-data plane is the JAX distributed runtime + collectives)."""
+"""Two-process jax.distributed test of the REAL serving stack.
+
+Each process boots the framework end to end — Holder -> Executor -> PQL
+— owning the shard slice cluster placement would give it (shard % 2 ==
+process id, the partition-hash analogue), executes the same queries
+through Executor.execute (gram batch pair counts, a general AST tree,
+and a BSI Sum), and the per-process partials combine across the
+distributed runtime via multihost allgather — the mapReduce reduce step
+riding the JAX distributed backend instead of the reference's
+HTTP+protobuf (SURVEY §2.4 mapping note; reference executor.go:2454
+mapReduce)."""
 
 import os
 import socket
@@ -21,6 +26,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
 
 sys.path.insert(0, os.environ["REPO"])
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH", "13")
 from pilosa_tpu.parallel.mesh import init_multihost
 
 pid = int(sys.argv[1])
@@ -32,39 +38,72 @@ mesh = init_multihost(
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 4, len(jax.devices())
 
-from jax.sharding import NamedSharding, PartitionSpec as P
-import jax.numpy as jnp
-from jax import lax
+from jax.experimental import multihost_utils
 
-spec = NamedSharding(mesh, P("shards", None, None))
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.exec.executor import Executor
 
-S, R, W = mesh.shape["shards"] * 2, mesh.shape["rows"] * 2, 64
-rng = np.random.default_rng(0)
-bits_np = rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint32)
+# ---- the real serving stack, per process ------------------------------
+holder = Holder()
+idx = holder.create_index("i")
+f = idx.create_field("f")
+v = idx.create_field("v", FieldOptions(field_type="int", min_=0, max_=500))
 
-# every process materializes its local slice of the global array
-def make_global(np_arr):
-    arrays = []
-    for d in mesh.local_devices:
-        idx = jax.sharding.NamedSharding(mesh, P("shards", None, None)).addressable_devices_indices_map((S, R, W))[d]
-        arrays.append(jax.device_put(np_arr[idx], d))
-    return jax.make_array_from_single_device_arrays((S, R, W), spec, arrays)
+N_SHARDS = 4
+width = holder.n_words * 32
+rng = np.random.default_rng(42)  # same data on every process
+rows = rng.integers(0, 5, size=4000)
+cols = rng.integers(0, N_SHARDS * width, size=4000)
+vcols = rng.choice(N_SHARDS * width, size=600, replace=False)
+vvals = rng.integers(0, 500, size=600)
 
-bits = make_global(bits_np)
+# ownership: shard % 2 == pid (the placement-hash analogue); each
+# process imports and serves ONLY its slice
+own = lambda c: (c // width) % 2 == pid
+m = own(cols)
+f.import_bits(rows[m].astype(np.uint64), cols[m])
+mv = own(vcols)
+v.import_values(vcols[mv], vvals[mv])
 
-@jax.jit
-def count_pair(bits):
-    words = bits[:, 0] & bits[:, 1]
-    return jnp.sum(lax.population_count(words).astype(jnp.int64))
+ex = Executor(holder)
+my_shards = [s for s in range(N_SHARDS) if s % 2 == pid]
 
-got = int(count_pair(bits))
-want = int(np.bitwise_count(bits_np[:, 0] & bits_np[:, 1]).sum())
-assert got == want, (got, want)
-print(f"proc{pid} OK {got}", flush=True)
+# gram-batched pair counts + a general AST tree + BSI Sum, all through
+# Executor.execute on the local shard slice
+res = ex.execute(
+    "i",
+    "Count(Intersect(Row(f=0), Row(f=1)))"
+    "Count(Union(Row(f=2), Row(f=3)))"
+    "Count(Intersect(Row(f=0), Row(f=1), Row(f=4)))"
+    "Sum(field=v)",
+    shards=my_shards,
+)
+partial = np.array(
+    [res[0], res[1], res[2], res[3].value, res[3].count], np.int64
+)
+
+# reduce across processes over the distributed runtime
+all_partials = multihost_utils.process_allgather(partial)
+total = all_partials.sum(axis=0)
+
+# ground truth from the full data (both processes know it)
+byrow = {}
+for r, c in zip(rows.tolist(), cols.tolist()):
+    byrow.setdefault(r, set()).add(c)
+want = [
+    len(byrow[0] & byrow[1]),
+    len(byrow[2] | byrow[3]),
+    len(byrow[0] & byrow[1] & byrow[4]),
+    int(vvals.sum()),
+    len(vcols),
+]
+assert total.tolist() == want, (total.tolist(), want)
+print(f"proc{pid} OK {total.tolist()}", flush=True)
 """
 
 
-def test_two_process_distributed_query(tmp_path):
+def test_two_process_distributed_executor(tmp_path):
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     coord = f"127.0.0.1:{s.getsockname()[1]}"
