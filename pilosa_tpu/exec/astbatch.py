@@ -62,7 +62,13 @@ _OPS = {
 # cover thousands of views).
 MAX_TIME_COVER = 16
 
-# sig nodes: ("row", field_name, view_name) | (op, *child_sigs)
+# sig nodes: ("row", stack_ordinal) | (op, *child_sigs).  Leaves refer
+# to (field, view) stacks by first-appearance ORDINAL, not by name: the
+# compiled program depends only on the tree shape and stack positions,
+# so a rolling time window (same cover shape, different view names)
+# reuses one program instead of tracing a fresh one per period.  The
+# actual (field, view) pairs ride alongside in ``pairs`` and join the
+# executor's launch-group key.
 
 
 def _stackable_field(idx, fname: str):
@@ -77,11 +83,26 @@ def _stackable_field(idx, fname: str):
     return field
 
 
-def match_tree(idx, call: Call, leaves: list[tuple[str, str, int]]):
+def _ordinal(pairs: list[tuple[str, str]], fname: str, vname: str) -> int:
+    pair = (fname, vname)
+    try:
+        return pairs.index(pair)
+    except ValueError:
+        pairs.append(pair)
+        return len(pairs) - 1
+
+
+def match_tree(
+    idx,
+    call: Call,
+    leaves: list[tuple[str, str, int]],
+    pairs: list[tuple[str, str]],
+):
     """``sig`` for a batchable bitmap tree, appending its
-    (field, view, row) leaves in traversal order; None when any node
-    falls outside the compilable set (BSI conditions, Shift, keyed
-    rows...)."""
+    (field, view, row) leaves in traversal order and the distinct
+    (field, view) stack pairs to ``pairs`` (the compiled program's
+    argument order); None when any node falls outside the compilable set
+    (BSI conditions, Shift, keyed rows...)."""
     name = call.name
     if name == "Row":
         fname = call.field_arg()
@@ -109,13 +130,16 @@ def match_tree(idx, call: Call, leaves: list[tuple[str, str, int]]):
                 return None
             for vname in cover:
                 leaves.append((fname, vname, v))
-            return ("union", *[("row", fname, vn) for vn in cover])
+            return (
+                "union",
+                *[("row", _ordinal(pairs, fname, vn)) for vn in cover],
+            )
         if set(call.args) != {fname}:
             return None
         if field.view(VIEW_STANDARD) is None:
             return None
         leaves.append((fname, VIEW_STANDARD, v))
-        return ("row", fname, VIEW_STANDARD)
+        return ("row", _ordinal(pairs, fname, VIEW_STANDARD))
     if name == "Not":
         # executeNot: exists-row difference (requires track_existence)
         if len(call.children) != 1 or call.args or not idx.track_existence:
@@ -124,17 +148,18 @@ def match_tree(idx, call: Call, leaves: list[tuple[str, str, int]]):
         if ef is None or ef.view(VIEW_STANDARD) is None:
             return None
         leaves.append((ef.name, VIEW_STANDARD, 0))
-        child = match_tree(idx, call.children[0], leaves)
+        esig = ("row", _ordinal(pairs, ef.name, VIEW_STANDARD))
+        child = match_tree(idx, call.children[0], leaves, pairs)
         if child is None:
             return None
-        return ("difference", ("row", ef.name, VIEW_STANDARD), child)
+        return ("difference", esig, child)
     op = _OPS.get(name)
     if op is not None:
         if not call.children or call.args:
             return None
         subs = []
         for c in call.children:
-            s = match_tree(idx, c, leaves)
+            s = match_tree(idx, c, leaves, pairs)
             if s is None:
                 return None
             subs.append(s)
@@ -142,7 +167,12 @@ def match_tree(idx, call: Call, leaves: list[tuple[str, str, int]]):
     return None
 
 
-def match_count(idx, call: Call, leaves: list[tuple[str, str, int]]):
+def match_count(
+    idx,
+    call: Call,
+    leaves: list[tuple[str, str, int]],
+    pairs: list[tuple[str, str]],
+):
     """sig for ``Count(tree)`` when the tree is compilable and not a bare
     Row (plain row counts are already one gather on the segment path)."""
     if call.name != "Count" or len(call.children) != 1 or call.args:
@@ -150,33 +180,16 @@ def match_count(idx, call: Call, leaves: list[tuple[str, str, int]]):
     child = call.children[0]
     if child.name == "Row":
         return None
-    return match_tree(idx, child, leaves)
+    return match_tree(idx, child, leaves, pairs)
 
 
-def sig_fields(sig) -> tuple[tuple[str, str], ...]:
-    """Distinct leaf (field, view) pairs in first-appearance order — the
-    compiled program's stack-argument order."""
-    out: list[tuple[str, str]] = []
-
-    def walk(s):
-        if s[0] == "row":
-            if (s[1], s[2]) not in out:
-                out.append((s[1], s[2]))
-            return
-        for k in s[1:]:
-            walk(k)
-
-    walk(sig)
-    return tuple(out)
-
-
-def _build(sig, findex: dict[str, int], ctr: list[int]):
+def _build(sig, ctr: list[int]):
     """Recursively build the tree evaluator: (stacks, slots) -> [S, W]
     words.  Leaf order mirrors match_tree's traversal order."""
     if sig[0] == "row":
         li = ctr[0]
         ctr[0] += 1
-        fi = findex[(sig[1], sig[2])]
+        fi = sig[1]
 
         def leaf(stacks, slots, li=li, fi=fi):
             s = slots[li]
@@ -187,7 +200,7 @@ def _build(sig, findex: dict[str, int], ctr: list[int]):
 
         return leaf
     op = sig[0]
-    kids = [_build(k, findex, ctr) for k in sig[1:]]
+    kids = [_build(k, ctr) for k in sig[1:]]
 
     if op == "difference":
         if len(kids) == 1:
@@ -221,10 +234,8 @@ def compiled(sig, count_mode: bool):
     counts (scan over the batch — no [B, S, W] materialization); bitmap
     programs take ``(stacks, slots[L])`` and return the uint32 ``[S, W]``
     result words."""
-    fields = sig_fields(sig)
-    findex = {f: i for i, f in enumerate(fields)}
     ctr = [0]
-    root = _build(sig, findex, ctr)
+    root = _build(sig, ctr)
     n_leaves = ctr[0]
 
     if count_mode:

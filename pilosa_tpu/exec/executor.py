@@ -534,25 +534,33 @@ class Executor:
         (stack builds are full-field uploads; they must amortize)."""
         from pilosa_tpu.exec import astbatch
 
+        # launch groups key on (canonical sig, actual stack pairs): the
+        # COMPILED program is shared across groups with the same shape
+        # (astbatch.compiled caches on sig alone — a rolling time window
+        # reuses one program), but each group launches with its own
+        # stacks
         count_groups: dict[tuple, list[tuple[int, list]]] = {}
-        bitmap_items: list[tuple[int, tuple, list]] = []
-        demand: dict[str, int] = {}
+        bitmap_items: list[tuple[int, tuple, tuple, list]] = []
+        demand: dict[tuple[str, str], int] = {}
         for i, call in enumerate(calls):
             if results[i] is not _UNSET:
                 continue
             leaves: list[tuple[str, str, int]] = []
-            sig = astbatch.match_count(idx, call, leaves)
+            pairs: list[tuple[str, str]] = []
+            sig = astbatch.match_count(idx, call, leaves, pairs)
             if sig is not None:
-                count_groups.setdefault(sig, []).append((i, leaves))
+                count_groups.setdefault((sig, tuple(pairs)), []).append(
+                    (i, leaves)
+                )
             elif call.name in ("Intersect", "Union", "Difference", "Xor", "Not"):
-                leaves = []
-                sig = astbatch.match_tree(idx, call, leaves)
+                leaves, pairs = [], []
+                sig = astbatch.match_tree(idx, call, leaves, pairs)
                 if sig is None:
                     continue
-                bitmap_items.append((i, sig, leaves))
+                bitmap_items.append((i, sig, tuple(pairs), leaves))
             else:
                 continue
-            for pair in astbatch.sig_fields(sig):
+            for pair in pairs:
                 demand[pair] = demand.get(pair, 0) + 1
         if not count_groups and not bitmap_items:
             return
@@ -564,10 +572,9 @@ class Executor:
         _ABSENT = object()
         stacks_by_view: dict[tuple[str, str], Any] = {}
 
-        def _stacks_for(sig):
+        def _stacks_for(pairs):
             """(stacks tuple, slot_of per (field, view)) or None when any
             leaf declines (cold + under-demanded, or over budget)."""
-            pairs = astbatch.sig_fields(sig)
             out: list[Any] = []
             slot_maps = {}
             for pair in pairs:
@@ -610,8 +617,8 @@ class Executor:
                 np.int32,
             )
 
-        for sig, items in count_groups.items():
-            st = _stacks_for(sig)
+        for (sig, pairs), items in count_groups.items():
+            st = _stacks_for(pairs)
             if st is None:
                 continue
             stacks, slot_maps = st
@@ -627,8 +634,8 @@ class Executor:
                 results[i] = int(totals[j])
                 self._count_stat(idx)
 
-        for i, sig, leaves in bitmap_items:
-            st = _stacks_for(sig)
+        for i, sig, pairs, leaves in bitmap_items:
+            st = _stacks_for(pairs)
             if st is None:
                 continue
             stacks, slot_maps = st

@@ -282,8 +282,9 @@ class Handler(BaseHTTPRequestHandler):
         if ctype.startswith("application/octet-stream"):
             from pilosa_tpu.cluster import wire
 
+            body = self._body()  # transport faults keep their own path
             try:
-                req = wire.decode_import(self._body())
+                req = wire.decode_import(body)
             except Exception as e:
                 # malformed client input, not a server fault (the JSON
                 # path 400s the same way via _json_body)

@@ -19,7 +19,9 @@ import threading
 
 class ImportPool:
     def __init__(self, workers: int = 2, depth: int = 16):
-        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        # depth <= 0 would make the queue unbounded, silently removing
+        # the backpressure this pool exists to provide
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._local = threading.local()
         self._closed = False
         self._threads = [

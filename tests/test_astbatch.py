@@ -205,3 +205,18 @@ class TestTimeRangeBatch:
         got = ex.execute("i", q)
         want = _fresh_executor(h).execute("i", q)
         assert got == want and got[0] == 3
+
+    def test_rolling_window_reuses_compiled_program(self, ex_time):
+        """Same cover SHAPE with different view names (a rolling window)
+        must not trace a fresh XLA program — sigs are canonicalized to
+        stack ordinals."""
+        _, ex = ex_time
+        q1 = "Count(Union(Row(t=9, from=2017-01-02T03:00, to=2017-01-02T05:00), Row(f=0)))"
+        ex.execute("i", q1 * 2)
+        info_before = astbatch.compiled.cache_info()
+        # shifted window: same number of hourly cover views, new names
+        q2 = "Count(Union(Row(t=9, from=2017-03-01T00:00, to=2017-03-01T02:00), Row(f=0)))"
+        ex.execute("i", q2 * 2)
+        info_after = astbatch.compiled.cache_info()
+        assert info_after.misses == info_before.misses
+        assert info_after.hits > info_before.hits
