@@ -402,6 +402,12 @@ int rt_deserialize(const uint8_t* data, size_t len, uint64_t** out,
   return 0;
 }
 
+uint32_t rt_fnv32a(const uint8_t* data, size_t len, uint32_t h) {
+  // exposed for the op-log writer: the Python FNV loop is ~7 MB/s and
+  // dominates sustained-ingest batches (encode_op checksums)
+  return fnv32a(h, data, len);
+}
+
 uint64_t rt_popcount(const uint8_t* data, size_t len) {
   uint64_t total = 0;
   size_t i = 0;

@@ -426,13 +426,17 @@ class Fragment:
             per_row = np.bitwise_count(mask).sum(axis=1, dtype=np.int64)
             changed_idx = np.nonzero(per_row)[0]
             for i in changed_idx:
-                s = int(slots[i])
-                self._dirty.add(s)
-                if self.store is not None:
-                    if clear:
-                        self.store.log_remove_mask(int(row_ids[i]), mask[i])
-                    else:
-                        self.store.log_add_mask(int(row_ids[i]), mask[i])
+                self._dirty.add(int(slots[i]))
+            if self.store is not None and len(changed_idx):
+                # one vectorized unpack for the whole batch's op records
+                if clear:
+                    self.store.log_remove_masks(
+                        row_ids[changed_idx], mask[changed_idx]
+                    )
+                else:
+                    self.store.log_add_masks(
+                        row_ids[changed_idx], mask[changed_idx]
+                    )
             if len(changed_idx):
                 self._counts = None
                 self.version += 1

@@ -85,6 +85,15 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.rt_popcount.restype = ctypes.c_uint64
+        try:
+            lib.rt_fnv32a.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+            ]
+            lib.rt_fnv32a.restype = ctypes.c_uint32
+        except AttributeError:
+            # an older prebuilt library without the symbol: fnv32a()
+            # degrades to None like every other entry point
+            lib = lib
         lib.rt_popcount.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_size_t,
@@ -150,3 +159,13 @@ def popcount(data: bytes | np.ndarray) -> int | None:
             arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.size
         )
     )
+
+
+def fnv32a(h: int, chunk: bytes) -> int | None:
+    """One FNV-1a round over ``chunk`` continuing from ``h``; None when
+    the native library (or this symbol, in an older prebuilt .so) is
+    unavailable."""
+    lib = load()
+    if lib is None or not hasattr(lib, "rt_fnv32a"):
+        return None
+    return int(lib.rt_fnv32a(chunk, len(chunk), h))

@@ -83,6 +83,26 @@ class FragmentFile:
         width = self.fragment.shard_width
         return np.uint64(row) * np.uint64(width) + bitops.unpack_columns(mask)
 
+    def _positions_multi(
+        self, rows: np.ndarray, masks: np.ndarray
+    ) -> np.ndarray:
+        """Positions for many (row, mask) pairs in ONE unpack+nonzero —
+        the per-row loop is the sustained-ingest hot path."""
+        width = self.fragment.shard_width
+        for r in rows:
+            self.check_row(int(r))
+        bits = np.unpackbits(
+            np.ascontiguousarray(masks, dtype=np.uint32)
+            .view(np.uint8)
+            .reshape(len(rows), -1),
+            axis=1,
+            bitorder="little",
+        )
+        sl, off = np.nonzero(bits)
+        return rows.astype(np.uint64)[sl] * np.uint64(width) + off.astype(
+            np.uint64
+        )
+
     def _append(self, record: bytes, count: int) -> None:
         with self._lock:
             if self._fh is None:
@@ -157,6 +177,20 @@ class FragmentFile:
             return
         self._emit_batch(roaring.OP_REMOVE_BATCH, positions)
 
+    def log_add_masks(self, rows: np.ndarray, masks: np.ndarray) -> None:
+        positions = self._positions_multi(rows, masks)
+        if self._batch_depth:
+            self._batch_add.append(positions)
+            return
+        self._emit_batch(roaring.OP_ADD_BATCH, positions)
+
+    def log_remove_masks(self, rows: np.ndarray, masks: np.ndarray) -> None:
+        positions = self._positions_multi(rows, masks)
+        if self._batch_depth:
+            self._batch_remove.append(positions)
+            return
+        self._emit_batch(roaring.OP_REMOVE_BATCH, positions)
+
     # -- snapshot -----------------------------------------------------------
 
     def request_snapshot(self) -> None:
@@ -189,16 +223,12 @@ class FragmentFile:
             self.op_n = 0
 
     def _all_positions(self) -> np.ndarray:
-        width = self.fragment.shard_width
-        parts = []
-        for row, words in sorted(self.fragment.to_host_rows().items()):
-            self.check_row(row)
-            parts.append(
-                np.uint64(row) * np.uint64(width) + bitops.unpack_columns(words)
-            )
-        if not parts:
+        items = sorted(self.fragment.to_host_rows().items())
+        if not items:
             return np.empty(0, dtype=np.uint64)
-        return np.concatenate(parts)
+        rows = np.array([r for r, _ in items], dtype=np.uint64)
+        masks = np.stack([w for _, w in items])
+        return self._positions_multi(rows, masks)
 
     def close(self) -> None:
         with self._lock:

@@ -56,6 +56,10 @@ _FNV_PRIME = 0x01000193
 def _fnv32a(*chunks: bytes) -> int:
     h = _FNV_OFFSET
     for chunk in chunks:
+        nh = _native.fnv32a(h, bytes(chunk))
+        if nh is not None:
+            h = nh
+            continue
         for b in chunk:
             h ^= b
             h = (h * _FNV_PRIME) & 0xFFFFFFFF

@@ -121,12 +121,16 @@ class _Procs:
         data_dir = self.tmp_path / f"node{pid}"
         data_dir.mkdir(exist_ok=True)
         (data_dir / ".id").write_text(f"node{pid}")
+        # log to a file, not a pipe: an undrained pipe would block a
+        # chatty node mid-test
+        log = open(self.tmp_path / f"node{pid}.log", "ab")
         self.procs[pid] = subprocess.Popen(
             [sys.executable, str(self.script), str(pid)],
             env=self.env,
-            stdout=subprocess.PIPE,
+            stdout=log,
             stderr=subprocess.STDOUT,
         )
+        log.close()
         _wait(
             lambda: _http(self.ports[pid], "GET", "/version"),
             60,
@@ -166,7 +170,7 @@ def test_kill_and_reconverge(tmp_path):
         # shard survives one node loss
         _http(ports[0], "POST", "/index/ci", {})
         _http(ports[0], "POST", "/index/ci/field/cf", {})
-        width = 1 << 13 << 5  # SHARD_WIDTH at the workers' 2^13 words
+        width = 1 << 13  # the workers' PILOSA_TPU_SHARD_WIDTH exponent
         cols = [(i * 37) % (3 * width) for i in range(300)]
         _http(
             ports[0],
