@@ -1698,35 +1698,58 @@ class Executor:
             return None
         slot1, bits1 = s1
         slot2, bits2 = s2
-        combos = [
-            (r1, r2)
-            for r1 in rows1
-            for r2 in rows2
-            if r1 in slot1 and r2 in slot2
-        ]
-        if not combos:
+        present1 = [r for r in rows1 if r in slot1]
+        present2 = [r for r in rows2 if r in slot2]
+        if not present1 or not present2:
             return []
-        B = 1 << (len(combos) - 1).bit_length()
-        ras = np.zeros(B, dtype=np.int32)
-        rbs = np.zeros(B, dtype=np.int32)
-        for j, (r1, r2) in enumerate(combos):
-            ras[j], rbs[j] = slot1[r1], slot2[r2]
         with tracing.start_span("executor.groupByBatch").set_tag(
-            "n", len(combos)
+            "n", len(present1) * len(present2)
         ):
+            # The full combination matrix is one cross-field gram scan on
+            # the MXU (kernels.cross_gram_xla); the batched AND+popcount
+            # kernels remain the fallback when the gram declines.
+            counts2d = None
             if f2 is f1:
-                partials = kernels.pair_count_batched(
-                    bits1, jnp.asarray(ras), jnp.asarray(rbs)
-                )
+                uniq = sorted({slot1[r] for r in present1 + present2})
+                g = kernels.pair_gram(bits1, uniq)
+                if g is not None:
+                    pos = {s: k for k, s in enumerate(uniq)}
+                    pa = np.array([pos[slot1[r]] for r in present1])
+                    pb = np.array([pos[slot1[r]] for r in present2])
+                    counts2d = g[np.ix_(pa, pb)]
             else:
-                partials = kernels.pair_count_two_batched(
-                    bits1, bits2, jnp.asarray(ras), jnp.asarray(rbs)
+                counts2d = kernels.cross_pair_gram(
+                    bits1,
+                    bits2,
+                    [slot1[r] for r in present1],
+                    [slot2[r] for r in present2],
                 )
-            counts = (
-                np.asarray(partials).astype(np.int64).sum(axis=1)
-            )
+            if counts2d is not None:
+                counts = counts2d.reshape(-1)
+            else:
+                combos_s = [
+                    (slot1[r1], slot2[r2])
+                    for r1 in present1
+                    for r2 in present2
+                ]
+                B = _pow2(len(combos_s))
+                ras = np.zeros(B, dtype=np.int32)
+                rbs = np.zeros(B, dtype=np.int32)
+                for j, (sa, sb) in enumerate(combos_s):
+                    ras[j], rbs[j] = sa, sb
+                if f2 is f1:
+                    partials = kernels.pair_count_batched(
+                        bits1, jnp.asarray(ras), jnp.asarray(rbs)
+                    )
+                else:
+                    partials = kernels.pair_count_two_batched(
+                        bits1, bits2, jnp.asarray(ras), jnp.asarray(rbs)
+                    )
+                counts = np.asarray(partials).astype(np.int64).sum(axis=1)
         out = []
-        for j, (r1, r2) in enumerate(combos):
+        for j, (r1, r2) in enumerate(
+            (r1, r2) for r1 in present1 for r2 in present2
+        ):
             c = int(counts[j])
             if c > 0:
                 out.append(

@@ -373,27 +373,42 @@ def _gram_word_block(w: int) -> int:
     return max(wb, 1)
 
 
+def _gram_blocks(bits: jax.Array, wb: int) -> jax.Array:
+    """[S, R, W] -> [S*nb, R, wb] word blocks in scan order."""
+    S, R, W = bits.shape
+    nb = W // wb
+    return bits.reshape(S, R, nb, wb).transpose(0, 2, 1, 3).reshape(
+        S * nb, R, wb
+    )
+
+
+def _unpack_int8(blk: jax.Array) -> jax.Array:
+    """[R, wb] uint32 words -> [R, wb*32] int8 0/1 for the MXU."""
+    R, wb = blk.shape
+    return ((blk[:, :, None] >> _SHIFTS32) & 1).astype(jnp.int8).reshape(
+        R, wb * 32
+    )
+
+
 @jax.jit
 def gram_matrix_xla(bits: jax.Array) -> jax.Array:
     """``G[i, j] = sum_s popcount(bits[s, i] & bits[s, j])`` for ALL row
     pairs, as one scan of the index with an int8 matmul per word block on
     the MXU (0/1 dot product == AND+popcount).
 
+    Kept separate from :func:`cross_gram_xla` deliberately: the self-gram
+    unpacks each block ONCE (cross would unpack both operands), and this
+    is the hottest serving kernel.
+
     int32 accumulation: per-block partials are <= wb*32 and callers
     (:func:`pair_gram`) chunk the shard axis so S * W * 32 < 2^31 —
     int64 cannot be used here because without ``jax_enable_x64`` JAX
     silently narrows it back to int32."""
-    S, R, W = bits.shape
-    wb = _gram_word_block(W)
-    nb = W // wb
-    blocks = bits.reshape(S, R, nb, wb).transpose(0, 2, 1, 3).reshape(
-        S * nb, R, wb
-    )
+    _, R, W = bits.shape
+    blocks = _gram_blocks(bits, _gram_word_block(W))
 
     def body(acc, blk):  # blk: [R, wb] uint32
-        x = ((blk[:, :, None] >> _SHIFTS32) & 1).astype(jnp.int8).reshape(
-            R, wb * 32
-        )
+        x = _unpack_int8(blk)
         g = lax.dot_general(
             x, x, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32,
@@ -516,6 +531,98 @@ def pair_counts_from_gram(
     if op == "xor":
         return da + db - 2 * g
     raise ValueError(f"unknown pair op: {op}")
+
+
+@jax.jit
+def cross_gram_xla(bits_a: jax.Array, bits_b: jax.Array) -> jax.Array:
+    """``G[i, j] = sum_s popcount(bits_a[s, i] & bits_b[s, j])`` for ALL
+    cross-field row pairs — the 2-level GroupBy combination matrix
+    (reference executor.go:3208-3211 counts the intersection of the last
+    two levels per combination; one MXU scan answers every combination).
+    int32 accumulation; callers chunk shards via :func:`cross_pair_gram`.
+    """
+    S, Ra, W = bits_a.shape
+    Rb = bits_b.shape[1]
+    wb = _gram_word_block(W)
+    blocks_a = _gram_blocks(bits_a, wb)
+    blocks_b = _gram_blocks(bits_b, wb)
+
+    def body(acc, blk):
+        ba, bb = blk
+        xa = _unpack_int8(ba)
+        xb = _unpack_int8(bb)
+        g = lax.dot_general(
+            xa, xb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc + g, None
+
+    acc0 = jnp.zeros((Ra, Rb), jnp.int32)
+    acc, _ = lax.scan(body, acc0, (blocks_a, blocks_b))
+    return acc
+
+
+@jax.jit
+def cross_gram_gather_xla(
+    bits_a: jax.Array, bits_b: jax.Array, ia: jax.Array, ib: jax.Array
+) -> jax.Array:
+    """Cross gram over row subsets, gathered inside the program."""
+    return cross_gram_xla(bits_a[:, ia], bits_b[:, ib])
+
+
+@lru_cache(maxsize=64)
+def _cross_gram_sharded_fn(mesh, axis):
+    local = lambda a, b, ia, ib: cross_gram_xla(a[:, ia], b[:, ib])[None]
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                P(axis, None, None), P(axis, None, None), P(None), P(None)
+            ),
+            out_specs=P(axis, None, None),
+            check_vma=False,  # same local-accumulation argument as
+        )  # _gram_sharded_fn
+    )
+
+
+def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
+    """``int64 numpy [Ua, Ub]`` cross-field intersection counts between
+    the named row subsets, summed over all shards; None when a subset is
+    too wide (callers fall back to the batched scan kernels).  Both
+    stacks must share the (aligned, equally-sharded) shard axis."""
+    S, _, W = bits_a.shape
+    Ua, Ub = len(idx_a), len(idx_b)
+    if Ua == 0 or Ub == 0 or max(Ua, Ub) > GRAM_MAX_ROWS:
+        return None
+    # pad gathers to powers of two for program reuse
+    ia = np.zeros(1 << (Ua - 1).bit_length(), np.int32)
+    ia[:Ua] = idx_a
+    ib = np.zeros(1 << (Ub - 1).bit_length(), np.int32)
+    ib[:Ub] = idx_b
+    m = shards_axis_of(bits_a)
+    if m is not None and shards_axis_of(bits_b) == m:
+        mesh, axis = m
+        if not _gram_int32_safe(-(-S // mesh.devices.size), W):
+            return None
+        out = _cross_gram_sharded_fn(mesh, axis)(
+            bits_a, bits_b, jnp.asarray(ia), jnp.asarray(ib)
+        )
+        return np.asarray(out).astype(np.int64).sum(axis=0)[:Ua, :Ub]
+    if m is not None or shards_axis_of(bits_b) is not None:
+        return None  # mismatched shardings; scan kernels handle it
+    ia_d, ib_d = jnp.asarray(ia), jnp.asarray(ib)
+    if _gram_int32_safe(S, W):
+        out = cross_gram_gather_xla(bits_a, bits_b, ia_d, ib_d)
+        return np.asarray(out).astype(np.int64)[:Ua, :Ub]
+    chunk = max(1, _GRAM_ACC_LIMIT // (W * 32))
+    total = np.zeros((len(ia), len(ib)), np.int64)
+    for c0 in range(0, S, chunk):
+        out = cross_gram_gather_xla(
+            bits_a[c0 : c0 + chunk], bits_b[c0 : c0 + chunk], ia_d, ib_d
+        )
+        total += np.asarray(out).astype(np.int64)
+    return total[:Ua, :Ub]
 
 
 # ---------------------------------------------------------------------------

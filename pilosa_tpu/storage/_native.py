@@ -26,6 +26,7 @@ _LIB_PATH = os.path.join(os.path.dirname(_SRC), "libpilosa_native.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+_has_fnv = False  # set at load(): the symbol is absent from older .so builds
 
 
 def _build() -> bool:
@@ -85,15 +86,17 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.rt_popcount.restype = ctypes.c_uint64
+        global _has_fnv
         try:
             lib.rt_fnv32a.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
             ]
             lib.rt_fnv32a.restype = ctypes.c_uint32
+            _has_fnv = True
         except AttributeError:
             # an older prebuilt library without the symbol: fnv32a()
             # degrades to None like every other entry point
-            lib = lib
+            _has_fnv = False
         lib.rt_popcount.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_size_t,
@@ -166,6 +169,6 @@ def fnv32a(h: int, chunk: bytes) -> int | None:
     the native library (or this symbol, in an older prebuilt .so) is
     unavailable."""
     lib = load()
-    if lib is None or not hasattr(lib, "rt_fnv32a"):
+    if lib is None or not _has_fnv:
         return None
     return int(lib.rt_fnv32a(chunk, len(chunk), h))

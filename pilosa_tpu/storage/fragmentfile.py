@@ -83,25 +83,40 @@ class FragmentFile:
         width = self.fragment.shard_width
         return np.uint64(row) * np.uint64(width) + bitops.unpack_columns(mask)
 
+    # rows per unpack block: bounds _positions_multi's transient uint8
+    # expansion (width bits -> bytes per row) to ~64 MiB
+    _UNPACK_BLOCK_BYTES = 64 << 20
+
     def _positions_multi(
         self, rows: np.ndarray, masks: np.ndarray
     ) -> np.ndarray:
-        """Positions for many (row, mask) pairs in ONE unpack+nonzero —
-        the per-row loop is the sustained-ingest hot path."""
+        """Positions for many (row, mask) pairs via blockwise
+        unpack+nonzero — the per-row loop is the sustained-ingest hot
+        path, but one giant unpack of every row would materialize
+        rows * width uint8 bytes, so blocks bound the transient."""
         width = self.fragment.shard_width
         for r in rows:
             self.check_row(int(r))
-        bits = np.unpackbits(
-            np.ascontiguousarray(masks, dtype=np.uint32)
-            .view(np.uint8)
-            .reshape(len(rows), -1),
-            axis=1,
-            bitorder="little",
-        )
-        sl, off = np.nonzero(bits)
-        return rows.astype(np.uint64)[sl] * np.uint64(width) + off.astype(
-            np.uint64
-        )
+        rows = rows.astype(np.uint64)
+        block = max(1, self._UNPACK_BLOCK_BYTES // max(width, 1))
+        parts = []
+        for b0 in range(0, len(rows), block):
+            sub = np.ascontiguousarray(
+                masks[b0 : b0 + block], dtype=np.uint32
+            )
+            bits = np.unpackbits(
+                sub.view(np.uint8).reshape(len(sub), -1),
+                axis=1,
+                bitorder="little",
+            )
+            sl, off = np.nonzero(bits)
+            parts.append(
+                rows[b0 : b0 + block][sl] * np.uint64(width)
+                + off.astype(np.uint64)
+            )
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
 
     def _append(self, record: bytes, count: int) -> None:
         with self._lock:

@@ -228,3 +228,55 @@ def test_pair_gram_chunked_when_int32_unsafe(monkeypatch):
     assert got.tolist() == want.tolist()
     got_sub = kernels.pair_gram(jnp.asarray(bits), [2, 0])
     assert got_sub[0, 1] == want[2, 0]
+
+
+def test_cross_gram_matches_pairwise():
+    rng = np.random.default_rng(31)
+    S, Ra, Rb, W = 3, 4, 5, 128
+    a = _rand_bits(rng, S, Ra, W)
+    b = _rand_bits(rng, S, Rb, W)
+    g = np.asarray(kernels.cross_gram_xla(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(Ra):
+        for j in range(Rb):
+            want = int(np.bitwise_count(a[:, i] & b[:, j]).sum())
+            assert g[i, j] == want
+
+
+def test_cross_pair_gram_subsets_and_chunking(monkeypatch):
+    rng = np.random.default_rng(32)
+    S, Ra, Rb, W = 5, 6, 4, 64
+    a = jnp.asarray(_rand_bits(rng, S, Ra, W))
+    b = jnp.asarray(_rand_bits(rng, S, Rb, W))
+    full = np.asarray(kernels.cross_gram_xla(a, b))
+    got = kernels.cross_pair_gram(a, b, [5, 0], [3, 1, 2])
+    assert got.shape == (2, 3)
+    assert got[0, 0] == full[5, 3] and got[1, 2] == full[0, 2]
+    # int32-unsafe shapes chunk the shard axis with host int64 recombine
+    monkeypatch.setattr(kernels, "_GRAM_ACC_LIMIT", 2 * W * 32)
+    got2 = kernels.cross_pair_gram(a, b, [5, 0], [3, 1, 2])
+    assert got2.tolist() == got.tolist()
+    # declines on over-wide subsets
+    assert kernels.cross_pair_gram(
+        a, b, list(range(kernels.GRAM_MAX_ROWS + 1)), [0]
+    ) is None
+
+
+def test_cross_pair_gram_sharded():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs multi-device mesh")
+    rng = np.random.default_rng(33)
+    n = len(devs)
+    S, Ra, Rb, W = 2 * n, 3, 4, 128
+    a = _rand_bits(rng, S, Ra, W)
+    b = _rand_bits(rng, S, Rb, W)
+    mesh = Mesh(np.array(devs), ("shards",))
+    spec = NamedSharding(mesh, P("shards", None, None))
+    ad = jax.device_put(a, spec)
+    bd = jax.device_put(b, spec)
+    got = kernels.cross_pair_gram(ad, bd, [0, 2], [1, 3])
+    full = np.asarray(kernels.cross_gram_xla(jnp.asarray(a), jnp.asarray(b)))
+    assert got[0, 0] == full[0, 1] and got[1, 1] == full[2, 3]
