@@ -1822,9 +1822,13 @@ class Executor:
                 if not rows:
                     return []
                 idxL = jnp.asarray([slotL[r] for r in rows], jnp.int32)
-                counts = np.asarray(
-                    kernels.combo_counts(prefix, bitsL, idxL)
-                ).astype(np.int64).sum(axis=2)  # [C, Rl]
+                # MXU cross gram when safe (one prefix read per level);
+                # per-shard scan partials otherwise
+                counts = kernels.combo_counts_gram(prefix, bitsL, idxL)
+                if counts is None:
+                    counts = np.asarray(
+                        kernels.combo_counts(prefix, bitsL, idxL)
+                    ).astype(np.int64).sum(axis=2)  # [C, Rl]
                 live = np.argwhere(counts > 0)  # row-major: DFS order
                 if li == len(levels) - 1:
                     out = []

@@ -848,6 +848,31 @@ def combo_counts(prefix: jax.Array, bits: jax.Array, idx: jax.Array) -> jax.Arra
 
 
 @jax.jit
+def _combo_gram_xla(prefix: jax.Array, bits: jax.Array, idx: jax.Array):
+    return cross_gram_xla(jnp.transpose(prefix, (1, 0, 2)), bits[:, idx])
+
+
+def combo_counts_gram(prefix: jax.Array, bits: jax.Array, idx) -> np.ndarray | None:
+    """``int64 numpy [C, Rl]`` totals of every (prefix combo, row)
+    intersection as ONE cross gram on the MXU — the k-level GroupBy's
+    per-level count (reference executor.go:3208-3211), reading the
+    prefix masks once instead of once per row.  None when a total could
+    wrap int32 (S * W * 32 past the limit) or the level is too small for
+    the unpack to pay off; callers fall back to :func:`combo_counts`."""
+    C = prefix.shape[0]
+    S, _, W = bits.shape
+    if not _gram_int32_safe(S, W) or C * len(idx) < 32:
+        return None
+    if shards_axis_of(bits) is not None or _multi_device(prefix):
+        # the gram scans over the SHARD axis, which would force GSPMD to
+        # replicate prefix + stack onto every device; the scan kernels
+        # iterate rows and partition cleanly, so decline
+        return None
+    out = _combo_gram_xla(prefix, bits, jnp.asarray(idx, jnp.int32))
+    return np.asarray(out).astype(np.int64)
+
+
+@jax.jit
 def refine_prefix(
     prefix: jax.Array, bits: jax.Array, cis: jax.Array, ris: jax.Array
 ) -> jax.Array:

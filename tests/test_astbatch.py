@@ -220,3 +220,36 @@ class TestTimeRangeBatch:
         info_after = astbatch.compiled.cache_info()
         assert info_after.misses == info_before.misses
         assert info_after.hits > info_before.hits
+
+
+class TestDifferentialFuzz:
+    """Randomized trees evaluated through the compiled one-launch path
+    must equal the per-fragment segment path — the executor analogue of
+    the reference's per-container-type differential op matrix
+    (roaring/roaring_internal_test.go)."""
+
+    def _rand_tree(self, rng, depth):
+        if depth == 0 or rng.random() < 0.35:
+            f = rng.choice(["f", "g"])
+            r = int(rng.integers(0, 8))  # some rows absent
+            return f"Row({f}={r})"
+        op = rng.choice(["Intersect", "Union", "Difference", "Xor", "Not"])
+        if op == "Not":
+            return f"Not({self._rand_tree(rng, depth - 1)})"
+        n = int(rng.integers(2, 4))
+        kids = ", ".join(self._rand_tree(rng, depth - 1) for _ in range(n))
+        return f"{op}({kids})"
+
+    def test_random_trees_match_segment_path(self, setup):
+        h, ex = setup
+        fresh = _fresh_executor(h)
+        rng = np.random.default_rng(77)
+        for trial in range(25):
+            tree = self._rand_tree(rng, 3)
+            q = f"Count({tree})Count({tree}){tree}"
+            got = ex.execute("i", q)
+            want = fresh.execute("i", q)
+            assert got[0] == want[0] == got[1], (trial, tree)
+            assert sorted(got[2].columns().tolist()) == sorted(
+                want[2].columns().tolist()
+            ), (trial, tree)

@@ -280,3 +280,21 @@ def test_cross_pair_gram_sharded():
     got = kernels.cross_pair_gram(ad, bd, [0, 2], [1, 3])
     full = np.asarray(kernels.cross_gram_xla(jnp.asarray(a), jnp.asarray(b)))
     assert got[0, 0] == full[0, 1] and got[1, 1] == full[2, 3]
+
+
+def test_combo_counts_gram_matches_scan():
+    rng = np.random.default_rng(34)
+    C, S, Rl, R, W = 8, 3, 5, 6, 64
+    prefix = jnp.asarray(_rand_bits(rng, C, S, W))
+    bits = jnp.asarray(_rand_bits(rng, S, R, W))
+    idx = jnp.asarray(np.array([0, 2, 4, 5, 1], np.int32))
+    got = kernels.combo_counts_gram(prefix, bits, idx)
+    assert got is not None
+    want = (
+        np.asarray(kernels.combo_counts(prefix, bits, idx))
+        .astype(np.int64)
+        .sum(axis=2)
+    )
+    assert got.tolist() == want.tolist()
+    # declines on tiny levels (unpack would not pay off)
+    assert kernels.combo_counts_gram(prefix[:2], bits, idx[:2]) is None
