@@ -863,6 +863,11 @@ def combo_counts_gram(prefix: jax.Array, bits: jax.Array, idx) -> np.ndarray | N
     S, _, W = bits.shape
     if not _gram_int32_safe(S, W) or C * len(idx) < 32:
         return None
+    if max(C, len(idx)) > GRAM_MAX_ROWS:
+        # same cap as every gram wrapper: the per-step int8 unpack is
+        # [C, wb*32] — a 65k-combo prefix would stage gigabytes where the
+        # scan fallback peaks at one [C, S, W] intermediate
+        return None
     if shards_axis_of(bits) is not None or _multi_device(prefix):
         # the gram scans over the SHARD axis, which would force GSPMD to
         # replicate prefix + stack onto every device; the scan kernels

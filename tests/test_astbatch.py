@@ -31,10 +31,11 @@ def setup():
     return h, ex
 
 
-def _fresh_executor(h):
+def _fresh_executor(h, like=None):
     """An executor whose batch paths are disabled — the ground-truth
-    per-fragment segment path."""
-    ex = Executor(h)
+    per-fragment segment path.  ``like`` shares its key translator (keyed
+    indexes translate ids back to keys at the result edge)."""
+    ex = Executor(h, translator=like.translator if like is not None else None)
     ex._batch_pair_counts = lambda *a, **k: None
     ex._batch_general = lambda *a, **k: None
     return ex
@@ -253,3 +254,45 @@ class TestDifferentialFuzz:
             assert sorted(got[2].columns().tolist()) == sorted(
                 want[2].columns().tolist()
             ), (trial, tree)
+
+
+class TestKeyedBatch:
+    """Keys translate to ids before the batch paths engage, so keyed
+    queries ride the same compiled programs (reference
+    executor.go:2613 translateCalls runs before execution)."""
+
+    @pytest.fixture()
+    def ex_keys(self):
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.exec.executor import Executor
+
+        h = Holder()
+        h.create_index("ki", keys=True, track_existence=True)
+        h.index("ki").create_field("f", FieldOptions(keys=True))
+        ex = Executor(h)
+        rng = np.random.default_rng(5)
+        writes = []
+        for name in ("one", "two", "three", "four"):
+            for col in rng.integers(0, 2 * h.n_words * 32, size=40):
+                writes.append(f'Set("c{int(col)}", f="{name}")')
+        ex.execute("ki", " ".join(writes))
+        return h, ex
+
+    def test_keyed_counts_match_segment_path(self, ex_keys):
+        h, ex = ex_keys
+        q = (
+            'Count(Intersect(Row(f="one"), Row(f="two"), Row(f="three")))'
+            'Count(Union(Row(f="one"), Row(f="four")))'
+            'Count(Intersect(Row(f="one"), Row(f="two"), Row(f="three")))'
+        )
+        got = ex.execute("ki", q)
+        want = _fresh_executor(h, like=ex).execute("ki", q)
+        assert got == want and got[0] == got[2]
+
+    def test_keyed_bitmap_tree_returns_keys(self, ex_keys):
+        h, ex = ex_keys
+        q = 'Union(Row(f="one"), Row(f="two"))' * 2
+        got = ex.execute("ki", q)
+        want = _fresh_executor(h, like=ex).execute("ki", q)
+        assert sorted(got[0].keys) == sorted(want[0].keys)
+        assert len(got[0].keys) > 0

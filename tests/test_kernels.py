@@ -298,3 +298,14 @@ def test_combo_counts_gram_matches_scan():
     assert got.tolist() == want.tolist()
     # declines on tiny levels (unpack would not pay off)
     assert kernels.combo_counts_gram(prefix[:2], bits, idx[:2]) is None
+
+
+def test_combo_counts_gram_declines_oversized_prefix():
+    rng = np.random.default_rng(35)
+    S, R, W = 2, 4, 64
+    bits = jnp.asarray(_rand_bits(rng, S, R, W))
+    big_c = kernels.GRAM_MAX_ROWS + 1
+    # shape-only check: a too-wide prefix must decline before any device
+    # work, so a zeros placeholder suffices
+    prefix = jnp.zeros((big_c, S, W), jnp.uint32)
+    assert kernels.combo_counts_gram(prefix, bits, jnp.arange(4)) is None
