@@ -608,6 +608,14 @@ class Fragment:
         """Sorted column offsets of a row (host materialization)."""
         return bitops.unpack_columns(self.row_words_host(row))
 
+    def rows_matrix_host(self) -> tuple[list[int], np.ndarray]:
+        """(row_ids, words[len(row_ids), W]) — one copy of every present
+        row in slot order, for bulk consumers (serving-stack builds) that
+        would otherwise pay a Python call + copy per row."""
+        with self._lock:
+            n = len(self._rowids)
+            return list(self._rowids), self._host[:n].copy()
+
     def row_count(self, row: int) -> int:
         with self._lock:
             s = self._slot_of.get(row)

@@ -297,10 +297,14 @@ class Executor:
                 f = frags.get(s)
                 if f is None:
                     continue
-                for r in f.row_ids():
-                    slot = slot_of.get(r)
-                    if slot is not None:  # fixed_rows: ignore strays
-                        bits[si, slot] = f.row_words_host(r)
+                # bulk matrix copy, not one Python call per row
+                ids, matrix = f.rows_matrix_host()
+                src = [
+                    k for k, r in enumerate(ids) if r in slot_of
+                ]  # fixed_rows: ignore strays
+                if src:
+                    dst = [slot_of[ids[k]] for k in src]
+                    bits[si, dst] = matrix[src]
             if mesh is not None:
                 dev = jax.device_put(
                     bits,
@@ -384,11 +388,13 @@ class Executor:
             f = frags.get(shards[si])
             if f is None:
                 return None
-            for r in f.row_ids():
-                slot = slot_of.get(r)
-                if slot is None:
-                    return None  # new row: shape change, full rebuild
-                blocks[k, slot] = f.row_words_host(r)
+            # membership check BEFORE the bulk copy: a new row means a
+            # full rebuild, and the copy would be discarded
+            if any(r not in slot_of for r in f.row_ids()):
+                return None  # new row: shape change, full rebuild
+            ids, matrix = f.rows_matrix_host()
+            if ids:
+                blocks[k, [slot_of[r] for r in ids]] = matrix
         dev = entry["dev"].at[jnp.asarray(changed, jnp.int32)].set(
             jnp.asarray(blocks)
         )
