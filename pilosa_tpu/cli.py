@@ -79,6 +79,9 @@ def _ensure_backend() -> None:
     node whose every query 500s."""
     import jax
 
+    from pilosa_tpu.platform import honor_platform_env
+
+    honor_platform_env()
     try:
         jax.devices()
     except Exception as e:

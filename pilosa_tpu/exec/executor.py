@@ -388,13 +388,15 @@ class Executor:
             f = frags.get(shards[si])
             if f is None:
                 return None
-            # membership check BEFORE the bulk copy: a new row means a
-            # full rebuild, and the copy would be discarded
-            if any(r not in slot_of for r in f.row_ids()):
-                return None  # new row: shape change, full rebuild
+            # ONE locked snapshot: checking membership via a separate
+            # row_ids() call would race a concurrent ingest adding a row
+            # between the check and the copy
             ids, matrix = f.rows_matrix_host()
+            dst = [slot_of.get(r) for r in ids]
+            if any(s is None for s in dst):
+                return None  # new row: shape change, full rebuild
             if ids:
-                blocks[k, [slot_of[r] for r in ids]] = matrix
+                blocks[k, dst] = matrix
         dev = entry["dev"].at[jnp.asarray(changed, jnp.int32)].set(
             jnp.asarray(blocks)
         )

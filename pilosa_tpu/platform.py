@@ -1,0 +1,24 @@
+"""Backend-selection helper.
+
+Some hosts register an accelerator backend from ``sitecustomize`` and pin
+``jax.config.jax_platforms`` programmatically, which silently overrides
+the ``JAX_PLATFORMS`` environment variable — so a user asking for the
+CPU backend (tests, offline demos, CI) can end up initializing a TPU
+tunnel that may hang.  Entry points call :func:`honor_platform_env`
+before first device use to re-assert the user's explicit choice; when
+the env var is unset the host's pin stands.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)

@@ -103,3 +103,26 @@ def test_graft_entry_single_and_multi():
     jax.block_until_ready(out)
     mod.dryrun_multichip(8)
     mod.dryrun_multichip(4)
+
+
+def test_honor_platform_env(monkeypatch):
+    """JAX_PLATFORMS must win over a host sitecustomize's programmatic
+    platform pin (the env var is the user's explicit choice)."""
+    import jax
+
+    from pilosa_tpu.platform import honor_platform_env
+
+    # simulate a host pin differing from the env choice (config updates
+    # are lazy: no backend initializes from setting the value)
+    jax.config.update("jax_platforms", "tpu,cpu")
+    try:
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        honor_platform_env()
+        assert jax.config.jax_platforms == "cpu"
+        # unset env: the host's pin stands (no update attempted)
+        jax.config.update("jax_platforms", "tpu,cpu")
+        monkeypatch.delenv("JAX_PLATFORMS")
+        honor_platform_env()
+        assert jax.config.jax_platforms == "tpu,cpu"
+    finally:
+        jax.config.update("jax_platforms", "cpu")
