@@ -19,8 +19,12 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
   measured 154 GB/s vs 106 GB/s for the best hand-written Pallas
   streaming kernel on the same shape — XLA's fusion of
   ``popcount + reduce`` beats manual VMEM staging here, so Pallas is OFF
-  by default (``PILOSA_TPU_PALLAS=1`` re-enables it for other hardware;
-  the kernels below still validate under interpret mode in tests).
+  by default (``PILOSA_TPU_PALLAS=1`` re-enables the row-scan kernels
+  for hardware where the balance differs; they compile on real TPU —
+  (8-shard, full-row, word-block) tiles — and validate under interpret
+  mode in tests).  The earlier scalar-prefetch pair-count kernels were
+  REMOVED: their one-row blocks violate the TPU (8, 128) tiling rule
+  outright, and the gram path supersedes them.
 """
 
 from __future__ import annotations
@@ -37,14 +41,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
-
-# Largest word-block a grid step streams into VMEM (uint32 words). 32768
-# words = one full 2^20-bit shard row = 128 KiB; two input rows double-
-# buffered stay well under the ~16 MiB VMEM budget.
-_MAX_WB = 32768
-
-# Rows per block for the row-scan kernel (sublane-aligned for uint32).
-_ROW_BLOCK = 8
 
 _OPS = {
     "intersect": lambda a, b: a & b,
@@ -75,8 +71,9 @@ def pallas_supported() -> bool:
     )
 
 
-def _word_block(w: int) -> int:
-    wb = min(w, _MAX_WB)
+def _word_block(w: int, cap: int) -> int:
+    """Largest power-of-two-ish divisor of ``w`` not exceeding ``cap``."""
+    wb = min(w, cap)
     while w % wb:
         wb //= 2
     return max(wb, 1)
@@ -85,68 +82,6 @@ def _word_block(w: int) -> int:
 # ---------------------------------------------------------------------------
 # Batched pair count: Count(op(Row(ra[i]), Row(rb[i]))) for i in [0, B)
 # ---------------------------------------------------------------------------
-
-
-def _pair_count_kernel(op, ras_ref, rbs_ref, a_ref, b_ref, out_ref):
-    del ras_ref, rbs_ref  # consumed by the index maps
-    w = pl.program_id(2)
-    words = _OPS[op](a_ref[0, 0, :], b_ref[0, 0, :])
-    block_total = jnp.sum(lax.population_count(words).astype(jnp.int32))
-
-    @pl.when(w == 0)
-    def _():
-        out_ref[0, 0] = block_total
-
-    @pl.when(w != 0)
-    def _():
-        out_ref[0, 0] = out_ref[0, 0] + block_total
-
-
-@partial(jax.jit, static_argnames=("op",))
-def pair_count_batched_pallas(
-    bits: jax.Array, ras: jax.Array, rbs: jax.Array, *, op: str = "intersect"
-) -> jax.Array:
-    """``int32[B, S]`` per-shard counts of
-    ``popcount(op(bits[:, ras[i]], bits[:, rbs[i]]))``.
-
-    One Pallas launch for the whole query batch; grid (B, S, W-blocks) with
-    the two query rows scalar-prefetch-indexed so only 2*WB words stream
-    into VMEM per step (reference executor.go:653-680 per-shard bitmap call
-    + roaring.go:568 count loop, batched the TPU way).  Per-shard partials
-    (a shard holds <= 2^20*rows bits, always int32-safe) are returned so
-    callers can sum in int64 host-side — cross-shard totals may pass 2^31.
-    """
-    S, R, W = bits.shape
-    B = ras.shape[0]
-    wb = _word_block(W)
-    grid = (B, S, W // wb)
-    kernel = partial(_pair_count_kernel, op)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, wb),
-                    lambda b, s, w, ras_ref, rbs_ref: (s, ras_ref[b], w),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (1, 1, wb),
-                    lambda b, s, w, ras_ref, rbs_ref: (s, rbs_ref[b], w),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1),
-                lambda b, s, w, ras_ref, rbs_ref: (b, s),
-                memory_space=pltpu.SMEM,
-            ),
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, S), jnp.int32),
-        interpret=_interpret(),
-    )(ras.astype(jnp.int32), rbs.astype(jnp.int32), bits, bits)
 
 
 @partial(jax.jit, static_argnames=("op",))
@@ -234,25 +169,17 @@ def shards_axis_of(x):
 
 
 @lru_cache(maxsize=64)
-def _pair_count_sharded_fn(mesh, axis, op, two_tensor, use_pallas):
+def _pair_count_sharded_fn(mesh, axis, op, two_tensor):
     """jit(shard_map) answering a pair-count batch over a shards-sharded
-    stack: each device runs the single-device kernel (Pallas on TPU, XLA
-    scan elsewhere) on its local shard block; per-shard partials
-    concatenate back along the shard axis — the ICI replacement for the
-    reference's per-node mapReduce fan-out (executor.go:2454-2611)."""
+    stack: each device runs the single-device scan on its local shard
+    block; per-shard partials concatenate back along the shard axis —
+    the ICI replacement for the reference's per-node mapReduce fan-out
+    (executor.go:2454-2611)."""
     if two_tensor:
-        local = partial(
-            pair_count_two_batched_pallas
-            if use_pallas
-            else pair_count_two_batched_xla,
-            op=op,
-        )
+        local = partial(pair_count_two_batched_xla, op=op)
         in_specs = (P(axis, None, None), P(axis, None, None), P(None), P(None))
     else:
-        local = partial(
-            pair_count_batched_pallas if use_pallas else pair_count_batched_xla,
-            op=op,
-        )
+        local = partial(pair_count_batched_xla, op=op)
         in_specs = (P(axis, None, None), P(None), P(None))
     return jax.jit(
         shard_map(
@@ -284,7 +211,9 @@ def _row_counts_sharded_fn(mesh, axis, use_pallas):
 def _run_sharded(builder, builder_args, call_args) -> jax.Array:
     """Invoke a sharded kernel with the same Pallas→XLA degradation
     contract as _try_pallas: a Pallas compile/runtime failure demotes and
-    re-answers with the XLA local kernel instead of failing the query."""
+    re-answers with the XLA local kernel instead of failing the query.
+    Builders take a trailing ``use_pallas`` flag; XLA-only kernels call
+    their jit(shard_map) builder directly instead."""
     global _pallas_ok
     use_pallas = pallas_supported() and _pallas_ok is not False
     if use_pallas:
@@ -336,16 +265,8 @@ def pair_count_batched(
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
-        return _run_sharded(
-            _pair_count_sharded_fn, (mesh, axis, op, False), (bits, ras, rbs)
-        )
-    return _try_pallas(
-        partial(pair_count_batched_pallas, op=op),
-        partial(pair_count_batched_xla, op=op),
-        bits,
-        ras,
-        rbs,
-    )
+        return _pair_count_sharded_fn(mesh, axis, op, False)(bits, ras, rbs)
+    return pair_count_batched_xla(bits, ras, rbs, op=op)
 
 
 # ---------------------------------------------------------------------------
@@ -367,10 +288,7 @@ _SHIFTS32 = np.arange(32, dtype=np.uint32)
 
 
 def _gram_word_block(w: int) -> int:
-    wb = min(w, _GRAM_WB)
-    while w % wb:
-        wb //= 2
-    return max(wb, 1)
+    return _word_block(w, _GRAM_WB)
 
 
 def _gram_blocks(bits: jax.Array, wb: int) -> jax.Array:
@@ -631,50 +549,6 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
 
 
 @partial(jax.jit, static_argnames=("op",))
-def pair_count_two_batched_pallas(
-    bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
-    *, op: str = "intersect",
-) -> jax.Array:
-    """``int32[B, S]`` per-shard counts of
-    ``popcount(op(bits_a[:, ras[i]], bits_b[:, rbs[i]]))``.
-
-    The cross-field shape of GroupBy's combination counts (reference
-    executor.go:3208-3211 counts the intersection of the last two
-    levels); both stacks must share the shard axis."""
-    S, _, W = bits_a.shape
-    B = ras.shape[0]
-    wb = _word_block(W)
-    grid = (B, S, W // wb)
-    kernel = partial(_pair_count_kernel, op)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, wb),
-                    lambda b, s, w, ras_ref, rbs_ref: (s, ras_ref[b], w),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (1, 1, wb),
-                    lambda b, s, w, ras_ref, rbs_ref: (s, rbs_ref[b], w),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1),
-                lambda b, s, w, ras_ref, rbs_ref: (b, s),
-                memory_space=pltpu.SMEM,
-            ),
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, S), jnp.int32),
-        interpret=_interpret(),
-    )(ras.astype(jnp.int32), rbs.astype(jnp.int32), bits_a, bits_b)
-
-
-@partial(jax.jit, static_argnames=("op",))
 def pair_count_two_batched_xla(
     bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
     *, op: str = "intersect",
@@ -697,19 +571,10 @@ def pair_count_two_batched(
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:
         mesh, axis = m
-        return _run_sharded(
-            _pair_count_sharded_fn,
-            (mesh, axis, op, True),
-            (bits_a, bits_b, ras, rbs),
+        return _pair_count_sharded_fn(mesh, axis, op, True)(
+            bits_a, bits_b, ras, rbs
         )
-    return _try_pallas(
-        partial(pair_count_two_batched_pallas, op=op),
-        partial(pair_count_two_batched_xla, op=op),
-        bits_a,
-        bits_b,
-        ras,
-        rbs,
-    )
+    return pair_count_two_batched_xla(bits_a, bits_b, ras, rbs, op=op)
 
 
 # ---------------------------------------------------------------------------
@@ -717,100 +582,84 @@ def pair_count_two_batched(
 # ---------------------------------------------------------------------------
 
 
-def _row_counts_kernel(in_ref, out_ref):
-    s = pl.program_id(1)
-    w = pl.program_id(2)
+def _row_scan_kernel(in_ref, out_ref):
+    """Accumulate per-(shard, row) popcounts over the word-block grid
+    axis.  Blocks are (SB shards, ALL rows, wb words) — dimensions that
+    satisfy the TPU (8, 128) tiling rule (the row axis equals the full
+    array dimension; earlier (1, rows, W) one-shard blocks did not
+    compile)."""
+    w = pl.program_id(1)
     pc = jnp.sum(
-        lax.population_count(in_ref[0]).astype(jnp.int32), axis=-1
-    )  # [ROW_BLOCK]
-
-    @pl.when(jnp.logical_and(s == 0, w == 0))
-    def _():
-        out_ref[0, :] = pc
-
-    @pl.when(jnp.logical_not(jnp.logical_and(s == 0, w == 0)))
-    def _():
-        out_ref[0, :] = out_ref[0, :] + pc
-
-
-def _row_counts_per_shard_kernel(in_ref, out_ref):
-    w = pl.program_id(2)
-    pc = jnp.sum(
-        lax.population_count(in_ref[0]).astype(jnp.int32), axis=-1
-    )  # [ROW_BLOCK]
+        lax.population_count(in_ref[...]).astype(jnp.int32), axis=-1
+    )  # [SB, R]
 
     @pl.when(w == 0)
     def _():
-        out_ref[0, :] = pc
+        out_ref[...] = pc
 
     @pl.when(w != 0)
     def _():
-        out_ref[0, :] = out_ref[0, :] + pc
+        out_ref[...] = out_ref[...] + pc
 
 
-@jax.jit
-def row_counts_pallas(bits: jax.Array) -> jax.Array:
-    """``int32[R]`` popcount per row over all shards (TopN scan,
-    reference fragment.go:459-498)."""
-    S, R, W = bits.shape
-    rb = _ROW_BLOCK
-    pad = (-R) % rb
-    if pad:
-        bits = jnp.pad(bits, ((0, 0), (0, pad), (0, 0)))
-    Rp = R + pad
-    wb = _word_block(W)
-    out = pl.pallas_call(
-        _row_counts_kernel,
-        grid=(Rp // rb, S, W // wb),
-        in_specs=[
-            pl.BlockSpec(
-                (1, rb, wb),
-                lambda r, s, w: (s, r, w),
-                memory_space=pltpu.VMEM,
-            )
-        ],
-        out_specs=pl.BlockSpec(
-            (1, rb),
-            lambda r, s, w: (0, r),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((1, Rp), jnp.int32),
-        interpret=_interpret(),
-    )(bits)
-    return out[0, :R]
+# shards per Pallas grid block (sublane-aligned)
+_SHARD_BLOCK = 8
+# word-block cap for the Pallas row scans
+_PALLAS_WB = 2048
+# per-tile byte target: an (sb, R, wb) uint32 block plus double buffering
+# must stay inside VMEM (~16 MiB on v5e)
+_PALLAS_VMEM_BUDGET = 8 << 20
+
+
+def _pallas_row_block(w: int, r: int) -> int:
+    """Word-block for an (SHARD_BLOCK, r, wb) tile within the VMEM
+    budget; 0 when no dividing block fits (callers use the XLA scan —
+    trying Pallas anyway would fail compile and permanently demote the
+    backend via _pallas_ok)."""
+    wb = _word_block(w, _PALLAS_WB)
+    while wb > 1 and _SHARD_BLOCK * r * wb * 4 > _PALLAS_VMEM_BUDGET:
+        if w % (wb // 2):
+            break
+        wb //= 2
+    if _SHARD_BLOCK * r * wb * 4 > _PALLAS_VMEM_BUDGET or wb < 128:
+        return 0
+    return wb
 
 
 @jax.jit
 def row_counts_per_shard_pallas(bits: jax.Array) -> jax.Array:
     """``int32[S, R]`` per-shard row popcounts (int32-safe per shard);
-    used instead of the fused cross-shard sum when totals could pass
-    2^31 — callers sum in int64 host-side."""
+    callers sum across shards in int64 host-side.  Measured ~106 GB/s on
+    v5e vs ~154 GB/s for the fused-XLA scan — kept for hardware where
+    the balance differs (PILOSA_TPU_PALLAS=1)."""
     S, R, W = bits.shape
-    rb = _ROW_BLOCK
-    pad = (-R) % rb
+    sb = _SHARD_BLOCK
+    wb = _pallas_row_block(W, R)
+    if not wb:
+        return row_counts_per_shard_xla(bits)  # tile cannot fit VMEM
+    pad = (-S) % sb
     if pad:
-        bits = jnp.pad(bits, ((0, 0), (0, pad), (0, 0)))
-    Rp = R + pad
-    wb = _word_block(W)
+        bits = jnp.pad(bits, ((0, pad), (0, 0), (0, 0)))
+    Sp = S + pad
     out = pl.pallas_call(
-        _row_counts_per_shard_kernel,
-        grid=(Rp // rb, S, W // wb),
+        _row_scan_kernel,
+        grid=(Sp // sb, W // wb),
         in_specs=[
-            pl.BlockSpec(
-                (1, rb, wb),
-                lambda r, s, w: (s, r, w),
-                memory_space=pltpu.VMEM,
-            )
+            pl.BlockSpec((sb, R, wb), lambda s, w: (s, 0, w)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, rb),
-            lambda r, s, w: (s, r),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((S, Rp), jnp.int32),
+        out_specs=pl.BlockSpec((sb, R), lambda s, w: (s, 0)),
+        out_shape=jax.ShapeDtypeStruct((Sp, R), jnp.int32),
         interpret=_interpret(),
     )(bits)
-    return out[:, :R]
+    return out[:S]
+
+
+@jax.jit
+def row_counts_pallas(bits: jax.Array) -> jax.Array:
+    """``int32[R]`` popcount per row over all shards (TopN scan,
+    reference fragment.go:459-498); the cross-shard sum fuses onto the
+    per-shard Pallas scan under jit."""
+    return jnp.sum(row_counts_per_shard_pallas(bits), axis=0)
 
 
 @jax.jit
@@ -898,18 +747,18 @@ def gather_prefix(bits: jax.Array, idx: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _masked_row_counts_kernel(bits_ref, filt_ref, out_ref):
-    w = pl.program_id(2)
-    words = bits_ref[0] & filt_ref[0][None, :]
+def _masked_row_scan_kernel(bits_ref, filt_ref, out_ref):
+    w = pl.program_id(1)
+    words = bits_ref[...] & filt_ref[...][:, None, :]
     pc = jnp.sum(lax.population_count(words).astype(jnp.int32), axis=-1)
 
     @pl.when(w == 0)
     def _():
-        out_ref[0, :] = pc
+        out_ref[...] = pc
 
     @pl.when(w != 0)
     def _():
-        out_ref[0, :] = out_ref[0, :] + pc
+        out_ref[...] = out_ref[...] + pc
 
 
 @jax.jit
@@ -917,38 +766,30 @@ def masked_row_counts_pallas(bits: jax.Array, filt: jax.Array) -> jax.Array:
     """``int32[S, R]`` per-shard popcounts of every row ANDed with a
     per-shard filter bitmap — the one-launch replacement for the
     per-shard host loop in filtered TopN (reference fragment.go:1586-1655
-    topWithFilter)."""
+    topWithFilter).  Same (8-shard, full-row, word-block) tiling as
+    :func:`row_counts_per_shard_pallas`."""
     S, R, W = bits.shape
-    rb = _ROW_BLOCK
-    pad = (-R) % rb
+    sb = _SHARD_BLOCK
+    wb = _pallas_row_block(W, R)
+    if not wb:
+        return masked_row_counts_xla(bits, filt)  # tile cannot fit VMEM
+    pad = (-S) % sb
     if pad:
-        bits = jnp.pad(bits, ((0, 0), (0, pad), (0, 0)))
-    Rp = R + pad
-    wb = _word_block(W)
+        bits = jnp.pad(bits, ((0, pad), (0, 0), (0, 0)))
+        filt = jnp.pad(filt, ((0, pad), (0, 0)))
+    Sp = S + pad
     out = pl.pallas_call(
-        _masked_row_counts_kernel,
-        grid=(Rp // rb, S, W // wb),
+        _masked_row_scan_kernel,
+        grid=(Sp // sb, W // wb),
         in_specs=[
-            pl.BlockSpec(
-                (1, rb, wb),
-                lambda r, s, w: (s, r, w),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, wb),
-                lambda r, s, w: (s, w),
-                memory_space=pltpu.VMEM,
-            ),
+            pl.BlockSpec((sb, R, wb), lambda s, w: (s, 0, w)),
+            pl.BlockSpec((sb, wb), lambda s, w: (s, w)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, rb),
-            lambda r, s, w: (s, r),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((S, Rp), jnp.int32),
+        out_specs=pl.BlockSpec((sb, R), lambda s, w: (s, 0)),
+        out_shape=jax.ShapeDtypeStruct((Sp, R), jnp.int32),
         interpret=_interpret(),
     )(bits, filt)
-    return out[:, :R]
+    return out[:S]
 
 
 @jax.jit
