@@ -17,7 +17,7 @@ cluster membership — redesigned for TPU:
   HTTP/protobuf fan-out.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
 

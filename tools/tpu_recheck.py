@@ -1,0 +1,78 @@
+"""Post-change TPU validation: run after kernel/executor changes when
+the chip is reachable (``python tools/tpu_recheck.py``).
+
+1. The retiled Pallas row scans must COMPILE on the real chip
+   (PILOSA_TPU_PALLAS=1 path) and match the XLA scan.
+2. The executor's gram batch path must answer correctly at serving shape.
+3. Quick pipelined rates for the serving kernels.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import kernels
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})")
+    S, R, W = 160, 64, 32768
+    key = jax.random.PRNGKey(7)
+    k1, k2 = jax.random.split(key)
+    bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
+        k2, (S, R, W), dtype=jnp.uint32
+    )
+    np.asarray(bits[0, 0, 0])
+
+    # 1. Pallas row scans compile + match
+    want = np.asarray(kernels.row_counts_per_shard_xla(bits))
+    try:
+        got = np.asarray(kernels.row_counts_per_shard_pallas(bits))
+        assert (got == want).all(), "pallas row scan MISMATCH"
+        print("pallas row scan: compiles, matches XLA")
+    except Exception as e:
+        print(f"pallas row scan FAILED: {type(e).__name__}: {str(e)[:200]}")
+    filt = jax.random.bits(k2, (S, W), dtype=jnp.uint32)
+    try:
+        got = np.asarray(kernels.masked_row_counts_pallas(bits, filt))
+        wantm = np.asarray(kernels.masked_row_counts_xla(bits, filt))
+        assert (got == wantm).all(), "pallas masked scan MISMATCH"
+        print("pallas masked scan: compiles, matches XLA")
+    except Exception as e:
+        print(f"pallas masked scan FAILED: {type(e).__name__}: {str(e)[:200]}")
+
+    # 2. gram correctness at serving shape
+    g = kernels.pair_gram(bits, list(range(R)))
+    ra, rb = 3, 7
+    want_pair = int(np.bitwise_count(np.asarray(bits[:, ra] & bits[:, rb])).sum())
+    assert int(g[ra, rb]) == want_pair, "gram MISMATCH"
+    print("gram: exact at serving shape")
+
+    # 3. pipelined rates
+    gram_salted = jax.jit(lambda b, s: kernels.gram_matrix_xla(b ^ s))
+    np.asarray(gram_salted(bits, jnp.uint32(9)))
+    t0 = time.perf_counter()
+    outs = [gram_salted(bits, jnp.uint32(i)) for i in range(4)]
+    np.asarray(outs[-1])
+    t = (time.perf_counter() - t0) / 4
+    print(f"gram: {t*1e3:.1f} ms/launch ({R*R/t:.0f} pairs/s)")
+    scan_salted = jax.jit(lambda b, s: kernels.row_counts_per_shard_xla(b ^ s))
+    np.asarray(scan_salted(bits, jnp.uint32(9)))
+    t0 = time.perf_counter()
+    outs = [scan_salted(bits, jnp.uint32(i)) for i in range(6)]
+    np.asarray(outs[-1])
+    t = (time.perf_counter() - t0) / 6
+    print(f"xla row scan: {t*1e3:.1f} ms ({S*R*W*4/t/1e9:.0f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()
