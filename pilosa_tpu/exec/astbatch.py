@@ -32,8 +32,6 @@ dispatch per query batch regardless of shard count or tree width.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Any
-
 import numpy as np
 
 import jax

@@ -31,13 +31,10 @@ from pilosa_tpu.obs import tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
     FIELD_TYPE_INT,
-    FIELD_TYPE_MUTEX,
-    FIELD_TYPE_TIME,
     FALSE_ROW_ID,
     TRUE_ROW_ID,
     Field,
 )
-from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.translate import TranslateStore
