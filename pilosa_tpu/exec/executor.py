@@ -397,6 +397,8 @@ class Executor:
         dev = entry["dev"].at[jnp.asarray(changed, jnp.int32)].set(
             jnp.asarray(blocks)
         )
+        entry.pop("gram", None)  # cached gram matched the old snapshot
+        entry.pop("gram_misses", None)  # reuse restarts per snapshot
         entry["dev"] = dev  # dev before versions: a racing reader keyed on
         entry["versions"] = versions  # versions must never see the old dev
         self.stack_incremental += 1
@@ -408,6 +410,66 @@ class Executor:
         self.holder.stats.count_with_tags(
             "query_total", 1, 1.0, (f"index:{idx.name}", f"call:{call_name}")
         )
+
+    # Fields up to this many rows may get their FULL gram computed and
+    # cached on the stack entry — the reference's ranked cache analogue
+    # (cache.go): repeat Count(op(Row,Row)) batches against an unchanged
+    # field then answer from host memory with zero device work.
+    _GRAM_CACHE_MAX_ROWS = 1024
+    # subset-gram computations against one stack snapshot before the full
+    # gram pays for itself (write-interleaved workloads never invest)
+    _GRAM_CACHE_MIN_REUSE = 2
+
+    def _field_gram(self, field: Field, shards: list[int], bits, uniq):
+        """(gram, pos) answering pair counts for the slot subset ``uniq``:
+        a full-row gram cached on the stack entry (identity positions) or
+        a fresh subset gram (enumerated positions); (None, None) when the
+        gram path declines entirely.
+
+        The cached gram is keyed to the entry's CURRENT device snapshot
+        (stored under the field's stack lock, which the incremental
+        refresh also holds) — a gram computed from an outdated ``bits``
+        is never installed, so cached answers always match the snapshot
+        the query reads.  The full gram is only computed when the subset
+        nearly covers the rows anyway or the snapshot has already served
+        _GRAM_CACHE_MIN_REUSE subset batches (observed reuse)."""
+        from pilosa_tpu.ops import kernels
+        from pilosa_tpu.parallel.mesh import serving_mesh
+
+        R = bits.shape[1]
+        caches = getattr(field, "_stack_caches", None)
+        entry = (
+            caches.get((serving_mesh(), tuple(shards), VIEW_STANDARD, None))
+            if caches
+            else None
+        )
+        if (
+            entry is not None
+            and entry.get("dev") is bits
+            and R <= self._GRAM_CACHE_MAX_ROWS
+        ):
+            cached = entry.get("gram")
+            if cached is not None and cached[0] is bits:
+                return cached[1], {s: s for s in uniq}
+            if (
+                2 * len(uniq) >= R
+                or entry.get("gram_misses", 0) >= self._GRAM_CACHE_MIN_REUSE
+            ):
+                g = kernels.pair_gram(bits, list(range(R)))
+                if g is not None:
+                    lock = vars(field).setdefault(
+                        "_stack_lock", threading.RLock()
+                    )
+                    with lock:
+                        if entry.get("dev") is bits:  # snapshot current
+                            entry["gram"] = (bits, g)
+                    return g, {s: s for s in uniq}
+            else:
+                entry["gram_misses"] = entry.get("gram_misses", 0) + 1
+        g = kernels.pair_gram(bits, uniq)
+        if g is None:
+            return None, None
+        return g, {s: k for k, s in enumerate(uniq)}
 
     def _batch_pair_counts(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -463,11 +525,10 @@ class Executor:
             # so mixed Intersect/Union/Difference/Xor Counts share one
             # index scan on the MXU (kernels.pair_gram).
             uniq = sorted({s for _, _, sa, sb in launch for s in (sa, sb)})
-            pos = {s: k for k, s in enumerate(uniq)}
             with tracing.start_span("executor.batchPairCount").set_tag(
                 "field", fname
             ).set_tag("n", len(launch)):
-                gram = kernels.pair_gram(bits, uniq)
+                gram, pos = self._field_gram(field, shard_list, bits, uniq)
                 if gram is not None:
                     pa = np.array([pos[sa] for _, _, sa, _ in launch])
                     pb = np.array([pos[sb] for _, _, _, sb in launch])
@@ -1716,9 +1777,8 @@ class Executor:
             counts2d = None
             if f2 is f1:
                 uniq = sorted({slot1[r] for r in present1 + present2})
-                g = kernels.pair_gram(bits1, uniq)
+                g, pos = self._field_gram(f1, shards, bits1, uniq)
                 if g is not None:
-                    pos = {s: k for k, s in enumerate(uniq)}
                     pa = np.array([pos[slot1[r]] for r in present1])
                     pb = np.array([pos[slot1[r]] for r in present2])
                     counts2d = g[np.ix_(pa, pb)]

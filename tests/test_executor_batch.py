@@ -262,3 +262,65 @@ def test_filtered_topn_tanimoto_matches(setup):
     finally:
         ex_mod.Executor._field_stack = old
     assert [(p.id, p.count) for p in fast] == [(p.id, p.count) for p in slow]
+
+
+class TestGramCache:
+    """The full-row gram caches on the stack entry (the ranked-cache
+    analogue, reference cache.go): repeat batches answer from host
+    memory, and any stack refresh drops it."""
+
+    def test_repeat_batches_reuse_cached_gram(self, setup, monkeypatch):
+        from pilosa_tpu.ops import kernels
+
+        _, ex = setup
+        calls = {"n": 0}
+        orig = kernels.pair_gram
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        monkeypatch.setattr(kernels, "pair_gram", counting)
+        q = _pairs_query([(0, 1), (2, 3), (4, 5)])
+        first = ex.execute("i", q)
+        n_after_first = calls["n"]
+        assert n_after_first >= 1
+        second = ex.execute("i", q)
+        assert calls["n"] == n_after_first  # cache hit: no new gram
+        assert first == second
+
+    def test_write_invalidates_cached_gram(self, setup):
+        _, ex = setup
+        q = _pairs_query([(0, 1), (2, 3)])
+        before = ex.execute("i", q)
+        ex.execute("i", "Set(123, f=0)Set(123, f=1)")
+        after = ex.execute("i", q)
+        assert after[0] == before[0] + 1  # new shared column counted
+
+    def test_small_subsets_defer_full_gram_until_reuse(self, setup, monkeypatch):
+        """Write-interleaved workloads must not pay full-row grams: the
+        full gram is only invested after observed reuse on one
+        snapshot."""
+        from pilosa_tpu.ops import kernels
+        from pilosa_tpu.exec.executor import Executor
+
+        _, ex = setup
+        seen = []
+        orig = kernels.pair_gram
+
+        def recording(bits, rows, *a, **k):
+            seen.append(len(rows))
+            return orig(bits, rows, *a, **k)
+
+        monkeypatch.setattr(kernels, "pair_gram", recording)
+        monkeypatch.setattr(Executor, "_GRAM_CACHE_MIN_REUSE", 2)
+        q = _pairs_query([(0, 1), (1, 0)])  # 2 of 6 rows: a small subset
+        ex.execute("i", q)
+        assert seen and seen[-1] == 2  # subset gram, not full
+        ex.execute("i", q)
+        assert seen[-1] == 2  # still subset (second miss)
+        ex.execute("i", q)
+        assert seen[-1] == 6  # observed reuse: full gram invested
+        n = len(seen)
+        ex.execute("i", q)
+        assert len(seen) == n  # cached: no further gram computation
