@@ -233,14 +233,13 @@ class Executor:
         if not frags:
             return None
         mesh = serving_mesh()
-        # The mesh is part of the key: a device-set/configure_serving
-        # change must invalidate stacks built with the old sharding.
-        # view + row-axis length too: the standard and BSI stacks of one
-        # field share the cache dict, and a BSI depth autogrow must build
-        # a fresh (wider) stack.
-        cache_key = (
-            mesh, tuple(shards), view_name,
+        # key and layout must use the SAME resolved mesh: resolving twice
+        # would let a concurrent configure_serving cache an old-mesh
+        # layout under the new mesh's key
+        cache_key = self._stack_key(
+            shards, view_name,
             len(fixed_rows) if fixed_rows is not None else None,
+            mesh=mesh,
         )
         versions = tuple(
             frags[s].version if s in frags else -1 for s in shards
@@ -420,7 +419,7 @@ class Executor:
     # gram pays for itself (write-interleaved workloads never invest)
     _GRAM_CACHE_MIN_REUSE = 2
 
-    def _field_gram(self, field: Field, shards: list[int], bits, uniq):
+    def _field_gram(self, field: Field, bits, uniq):
         """(gram, pos) answering pair counts for the slot subset ``uniq``:
         a full-row gram cached on the stack entry (identity positions) or
         a fresh subset gram (enumerated positions); (None, None) when the
@@ -434,20 +433,32 @@ class Executor:
         nearly covers the rows anyway or the snapshot has already served
         _GRAM_CACHE_MIN_REUSE subset batches (observed reuse)."""
         from pilosa_tpu.ops import kernels
-        from pilosa_tpu.parallel.mesh import serving_mesh
 
         R = bits.shape[1]
+        # Find the owning cache entry by snapshot identity rather than by
+        # rebuilding _field_stack's cache key (which would silently go
+        # stale if the key shape ever changed); the cache holds at most a
+        # handful of entries. The budget's _evict pops the dict lock-free
+        # from arbitrary threads, so the scan retries on a mid-iteration
+        # mutation and degrades to a cache miss rather than failing the
+        # query.
+        entry = None
         caches = getattr(field, "_stack_caches", None)
-        entry = (
-            caches.get((serving_mesh(), tuple(shards), VIEW_STANDARD, None))
-            if caches
-            else None
-        )
-        if (
-            entry is not None
-            and entry.get("dev") is bits
-            and R <= self._GRAM_CACHE_MAX_ROWS
-        ):
+        if caches:
+            for _ in range(3):
+                try:
+                    entry = next(
+                        (
+                            e
+                            for e in list(caches.values())
+                            if e.get("dev") is bits
+                        ),
+                        None,
+                    )
+                    break
+                except RuntimeError:
+                    continue  # dict mutated mid-scan; retry then miss
+        if entry is not None and R <= self._GRAM_CACHE_MAX_ROWS:
             cached = entry.get("gram")
             if cached is not None and cached[0] is bits:
                 return cached[1], {s: s for s in uniq}
@@ -528,7 +539,7 @@ class Executor:
             with tracing.start_span("executor.batchPairCount").set_tag(
                 "field", fname
             ).set_tag("n", len(launch)):
-                gram, pos = self._field_gram(field, shard_list, bits, uniq)
+                gram, pos = self._field_gram(field, bits, uniq)
                 if gram is not None:
                     pa = np.array([pos[sa] for _, _, sa, _ in launch])
                     pb = np.array([pos[sb] for _, _, _, sb in launch])
@@ -566,6 +577,28 @@ class Executor:
 
     # ------------------------------------------ general AST one-launch path
 
+    _UNRESOLVED = object()  # serving_mesh() may itself be None
+
+    @staticmethod
+    def _stack_key(
+        shards: list[int],
+        view_name: str,
+        n_fixed_rows: int | None,
+        mesh=_UNRESOLVED,
+    ) -> tuple:
+        """Stack-cache key. The mesh is part of the key: a device-set/
+        configure_serving change must invalidate stacks built with the
+        old sharding. View + row-axis length too: the standard and BSI
+        stacks of one field share the cache dict, and a BSI depth
+        autogrow must build a fresh (wider) stack. Pass ``mesh`` when the
+        caller has already resolved it (and uses it for layout) so key
+        and layout can never disagree."""
+        from pilosa_tpu.parallel.mesh import serving_mesh
+
+        if mesh is Executor._UNRESOLVED:
+            mesh = serving_mesh()
+        return (mesh, tuple(shards), view_name, n_fixed_rows)
+
     def _stack_cached(
         self,
         field: Field,
@@ -575,14 +608,10 @@ class Executor:
     ) -> bool:
         """Whether a serving stack for this (field, shards) is already
         live — a peek that never builds."""
-        from pilosa_tpu.parallel.mesh import serving_mesh
-
         caches = getattr(field, "_stack_caches", None)
         if not caches:
             return False
-        return (
-            serving_mesh(), tuple(shard_list), view_name, n_fixed_rows
-        ) in caches
+        return self._stack_key(shard_list, view_name, n_fixed_rows) in caches
 
     def _batch_general(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -1777,7 +1806,7 @@ class Executor:
             counts2d = None
             if f2 is f1:
                 uniq = sorted({slot1[r] for r in present1 + present2})
-                g, pos = self._field_gram(f1, shards, bits1, uniq)
+                g, pos = self._field_gram(f1, bits1, uniq)
                 if g is not None:
                     pa = np.array([pos[slot1[r]] for r in present1])
                     pb = np.array([pos[slot1[r]] for r in present2])

@@ -83,39 +83,40 @@ class FragmentFile:
         width = self.fragment.shard_width
         return np.uint64(row) * np.uint64(width) + bitops.unpack_columns(mask)
 
-    # rows per unpack block: bounds _positions_multi's transient uint8
-    # expansion (width bits -> bytes per row) to ~64 MiB
-    _UNPACK_BLOCK_BYTES = 64 << 20
-
     def _positions_multi(
         self, rows: np.ndarray, masks: np.ndarray
     ) -> np.ndarray:
-        """Positions for many (row, mask) pairs via blockwise
-        unpack+nonzero — the per-row loop is the sustained-ingest hot
-        path, but one giant unpack of every row would materialize
-        rows * width uint8 bytes, so blocks bound the transient."""
+        """Positions for many (row, mask) pairs — the sustained-ingest hot
+        path. Masks are sparse relative to the full row width, so only the
+        nonzero *words* are expanded to bit offsets (a 32-wide table per
+        set word) rather than unpacking every bit of every row."""
         width = self.fragment.shard_width
         for r in rows:
             self.check_row(int(r))
         rows = rows.astype(np.uint64)
-        block = max(1, self._UNPACK_BLOCK_BYTES // max(width, 1))
+        masks = np.ascontiguousarray(masks, dtype=np.uint32)
+        sl, wi = np.nonzero(masks)
+        if not len(sl):
+            return np.empty(0, dtype=np.uint64)
+        words = np.ascontiguousarray(masks[sl, wi])
+        word_pos = rows[sl] * np.uint64(width) + wi.astype(np.uint64) * np.uint64(32)
+        # Expand each nonzero word's bits blockwise via unpackbits (uint8
+        # end to end, no wider intermediate): 32 bytes per word per block
+        # keeps the transient bounded (~64 MiB) even for dense fragments,
+        # where one unblocked expansion would be multi-GiB.
+        block = (64 << 20) // 32
         parts = []
-        for b0 in range(0, len(rows), block):
-            sub = np.ascontiguousarray(
-                masks[b0 : b0 + block], dtype=np.uint32
-            )
+        for b0 in range(0, len(words), block):
+            w = words[b0 : b0 + block]
             bits = np.unpackbits(
-                sub.view(np.uint8).reshape(len(sub), -1),
+                w.view(np.uint8).reshape(len(w), 4),
                 axis=1,
                 bitorder="little",
             )
-            sl, off = np.nonzero(bits)
-            parts.append(
-                rows[b0 : b0 + block][sl] * np.uint64(width)
-                + off.astype(np.uint64)
-            )
-        if not parts:
-            return np.empty(0, dtype=np.uint64)
+            wsel, b = np.nonzero(bits)
+            # row-major nonzero keeps the (row, word, bit) sort order the
+            # previous full-unpack implementation produced
+            parts.append(word_pos[b0 + wsel] + b.astype(np.uint64))
         return np.concatenate(parts)
 
     def _append(self, record: bytes, count: int) -> None:
