@@ -114,6 +114,9 @@ class Executor:
         self.stack_incremental = 0
         # stacked-BSI launches (tests assert O(1) dispatch per BSI query)
         self.bsi_stack_launches = 0
+        # pair counts answered from the cached host gram (zero device
+        # work — the serving mode for repeat sequential queries)
+        self.gram_cache_hits = 0
 
     # ------------------------------------------------------------------ API
 
@@ -461,6 +464,7 @@ class Executor:
         if entry is not None and R <= self._GRAM_CACHE_MAX_ROWS:
             cached = entry.get("gram")
             if cached is not None and cached[0] is bits:
+                self.gram_cache_hits += 1
                 return cached[1], {s: s for s in uniq}
             if (
                 2 * len(uniq) >= R
@@ -481,6 +485,25 @@ class Executor:
         if g is None:
             return None, None
         return g, {s: k for k, s in enumerate(uniq)}
+
+    # lone Count(op(Row,Row)) queries against one field seen before the
+    # stack+gram investment is judged worthwhile for singles (the warm-up
+    # the reference's ranked cache pays on its first TopN, cache.go)
+    _PAIR_SINGLE_WARM = 4
+
+    def _pair_single_ready(self, field: Field, shard_list: list[int]) -> bool:
+        """Whether a LONE pair-count should take the gram path. True when
+        a serving stack is already live (answering from it beats the
+        per-fragment path, and repeat singles then install + hit the
+        cached host gram: zero device work per query) or when repeat
+        singles against this field prove reuse."""
+        if self._stack_cached(field, shard_list):
+            return True
+        lock = vars(field).setdefault("_stack_lock", threading.RLock())
+        with lock:
+            n = vars(field).get("_pair_single_demand", 0) + 1
+            field._pair_single_demand = n
+        return n >= self._PAIR_SINGLE_WARM
 
     def _batch_pair_counts(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -508,13 +531,25 @@ class Executor:
         _count_stat = lambda: self._count_stat(idx)
 
         for fname, items in by_field.items():
-            if len(items) < 2:
-                continue
             field = idx.field(fname)
             if shard_list is None:
                 shard_list = self._shards_for(idx, shards)
+            if len(items) < 2 and not self._pair_single_ready(
+                field, shard_list
+            ):
+                continue
             stack = self._field_stack(field, shard_list)
             if stack is None:
+                if len(items) < 2:
+                    # over-budget field: restart the warm-up so singles
+                    # don't pay a declined build attempt on every query
+                    # (same lock as _pair_single_ready's read-modify-write,
+                    # or a concurrent increment could overwrite the reset)
+                    lock = vars(field).setdefault(
+                        "_stack_lock", threading.RLock()
+                    )
+                    with lock:
+                        field._pair_single_demand = 0
                 continue
             slot_of, bits = stack
             launch: list[tuple[int, str, int, int]] = []

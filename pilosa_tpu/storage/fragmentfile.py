@@ -38,6 +38,11 @@ class FragmentFile:
         self._fh = None
         self._closed = False
         self.op_n = 0
+        # monotonic append counter — unlike op_n it NEVER resets, so the
+        # optimistic snapshot's "no op landed since my copy" check can't
+        # be fooled by op_n cycling back to the same value (ABA) after a
+        # concurrent snapshot reset it
+        self.mut_seq = 0
         # per-mutation op batching (begin_batch/end_batch): buffered
         # positions flushed as single batch records. Caller guarantees the
         # add and remove sets of one batch are disjoint (true for all
@@ -127,6 +132,7 @@ class FragmentFile:
             self._fh.flush()
             os.fsync(self._fh.fileno())  # durable against power loss
             self.op_n += count
+            self.mut_seq += 1
         if self.op_n > MAX_OP_N:
             self.request_snapshot()
 
@@ -215,36 +221,81 @@ class FragmentFile:
         else:
             self.snapshot()
 
+    # optimistic snapshot attempts before falling back to holding the
+    # fragment lock for the whole rewrite (continuous writers would
+    # otherwise livelock the retry loop)
+    _SNAPSHOT_RETRIES = 3
+
     def snapshot(self) -> None:
         """Atomic rewrite: temp file + rename (reference
-        fragment.go:2335-2381). Takes the fragment lock FIRST (matching the
-        writer path's fragment->store lock order) so a concurrent mutation
-        can't interleave between the state gather and the file swap."""
-        with self.fragment._lock, self._lock:
-            if self._closed:
-                # A snapshot queued before the store was detached (e.g.
-                # the fragment was dropped by resize cleanup) must not
-                # resurrect the deleted file.
-                return
-            positions = self._all_positions()
-            tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                f.write(roaring.serialize(positions))
-                f.flush()
-                os.fsync(f.fileno())
-            if self._fh is not None:
-                self._fh.close()
-            os.replace(tmp, self.path)
-            self._fh = open(self.path, "ab")
-            self.op_n = 0
+        fragment.go:2335-2381).
 
-    def _all_positions(self) -> np.ndarray:
-        items = sorted(self.fragment.to_host_rows().items())
+        The expensive work (position extraction + roaring encode + fsync)
+        runs WITHOUT the fragment lock, from a copied state — a snapshot
+        worker must not stall concurrent queries/ingest for the whole
+        rewrite. The swap then happens under the lock only if no op was
+        appended since the copy (an op landing in between would be in the
+        fragment's mirror but lost from the replaced file's op log);
+        otherwise retry with a fresh copy, degrading to the fully locked
+        path after _SNAPSHOT_RETRIES so a continuous writer can't
+        livelock us. Lock order fragment->store matches the writer path."""
+        for attempt in range(self._SNAPSHOT_RETRIES + 1):
+            locked_rewrite = attempt == self._SNAPSHOT_RETRIES
+            with self.fragment._lock:
+                if locked_rewrite:
+                    # final attempt: hold the lock across extract + swap
+                    with self._lock:
+                        if self._closed:
+                            return
+                        self._write_snapshot_file(
+                            roaring.serialize(self._all_positions())
+                        )
+                        return
+                with self._lock:
+                    if self._closed:
+                        # A snapshot queued before the store was detached
+                        # (e.g. the fragment was dropped by resize
+                        # cleanup) must not resurrect the deleted file.
+                        return
+                    seq_at = self.mut_seq
+                items = sorted(self.fragment.to_host_rows().items())
+            data = roaring.serialize(self._positions_from_items(items))
+            with self.fragment._lock, self._lock:
+                if self._closed:
+                    return
+                if self.mut_seq != seq_at:
+                    continue  # an op landed mid-encode; redo from fresh state
+                self._write_snapshot_file(data)
+                return
+
+    def _write_snapshot_file(self, data: bytes) -> None:
+        """Swap in an encoded snapshot (both locks held)."""
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if self._fh is not None:
+            self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "ab")
+        self.op_n = 0
+
+    def _positions_from_items(
+        self, items: list[tuple[int, np.ndarray]]
+    ) -> np.ndarray:
+        """Snapshot payload for sorted (row, mask) pairs — shared by the
+        optimistic and lock-held rewrite paths so they can't diverge."""
         if not items:
             return np.empty(0, dtype=np.uint64)
         rows = np.array([r for r, _ in items], dtype=np.uint64)
         masks = np.stack([w for _, w in items])
         return self._positions_multi(rows, masks)
+
+    def _all_positions(self) -> np.ndarray:
+        return self._positions_from_items(
+            sorted(self.fragment.to_host_rows().items())
+        )
 
     def close(self) -> None:
         with self._lock:

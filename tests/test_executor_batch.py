@@ -324,3 +324,51 @@ class TestGramCache:
         n = len(seen)
         ex.execute("i", q)
         assert len(seen) == n  # cached: no further gram computation
+
+
+class TestSinglePairServing:
+    """Repeat LONE Count(op(Row,Row)) queries must warm up into the
+    stack+gram path and then be served from the cached host gram with
+    zero device work (the reference's ranked cache serving role,
+    cache.go: repeat reads answered from memory)."""
+
+    def test_singles_warm_then_serve_from_gram(self, setup):
+        _, ex = setup
+        q = "Count(Intersect(Row(f=0), Row(f=1)))"
+        want = ex.execute("i", q)[0]
+        # enough repeats to pass the warm-up threshold and the gram's
+        # observed-reuse investment gate
+        for _ in range(ex._PAIR_SINGLE_WARM + ex._GRAM_CACHE_MIN_REUSE + 2):
+            assert ex.execute("i", q)[0] == want
+        assert ex.gram_cache_hits >= 1
+        hits, rebuilds = ex.gram_cache_hits, ex.stack_rebuilds
+        # steady state: every further single is a pure host cache hit —
+        # no stack rebuild, correct answers for other pairs too
+        q2 = "Count(Union(Row(f=2), Row(f=3)))"
+        want2 = ex.execute("i", _pairs_query([(2, 3)], op="Union"))[0]
+        for _ in range(3):
+            assert ex.execute("i", q)[0] == want
+            assert ex.execute("i", q2)[0] == want2
+        assert ex.gram_cache_hits >= hits + 6
+        assert ex.stack_rebuilds == rebuilds
+
+    def test_cold_singles_stay_on_per_call_path(self, setup):
+        """A few one-off pair counts must NOT pay the stack build."""
+        _, ex = setup
+        q = "Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(2):
+            ex.execute("i", q)
+        assert ex.stack_rebuilds == 0
+
+    def test_write_invalidates_served_gram(self, setup):
+        """A write between served singles must be visible (the gram is
+        keyed to the stack snapshot, never stale)."""
+        _, ex = setup
+        q = "Count(Intersect(Row(f=0), Row(f=1)))"
+        for _ in range(ex._PAIR_SINGLE_WARM + ex._GRAM_CACHE_MIN_REUSE + 2):
+            before = ex.execute("i", q)[0]
+        # add a column present in both rows: count must rise by 1
+        free = 777_777
+        ex.execute("i", f"Set({free}, f=0) Set({free}, f=1)")
+        after = ex.execute("i", q)[0]
+        assert after == before + 1

@@ -325,3 +325,77 @@ class TestTranslateLog:
         hs2.open()
         assert hs2.translator.translate_key("ki", "", "u2", create=False) == 2
         hs2.close()
+
+
+class TestSnapshotConcurrentWrite:
+    """snapshot() encodes from a copied state without the fragment lock;
+    an op appended between the copy and the file swap must never be lost
+    (the swap retries from fresh state when the monotonic mut_seq
+    advanced — op_n can't be the guard, it resets on every swap)."""
+
+    def test_op_landing_mid_encode_survives_reopen(self, tmp_path, monkeypatch):
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.storage import fragmentfile
+        from pilosa_tpu.storage.fragmentfile import FragmentFile
+
+        frag = Fragment(n_words=64)
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        frag.set_bit(1, 10)
+        frag.set_bit(2, 20)
+
+        real_serialize = fragmentfile.roaring.serialize
+        fired = {"n": 0}
+
+        def racing_serialize(positions):
+            # simulate a concurrent writer landing mid-encode, exactly
+            # once (the retried snapshot also calls serialize)
+            if fired["n"] == 0:
+                fired["n"] += 1
+                frag.set_bit(3, 30)
+            return real_serialize(positions)
+
+        monkeypatch.setattr(fragmentfile.roaring, "serialize", racing_serialize)
+        store.snapshot()
+        monkeypatch.setattr(fragmentfile.roaring, "serialize", real_serialize)
+        store.close()
+
+        frag2 = Fragment(n_words=64)
+        store2 = FragmentFile(frag2, str(tmp_path / "frag"))
+        store2.open()
+        rows = frag2.to_host_rows()
+        assert 3 in rows and bool(rows[3][30 // 32] & (1 << (30 % 32)))
+        assert 1 in rows and 2 in rows
+        store2.close()
+
+    def test_locked_fallback_after_retries(self, tmp_path, monkeypatch):
+        """A writer racing every optimistic attempt must not livelock:
+        the final attempt rewrites under the fragment lock."""
+        from pilosa_tpu.core.fragment import Fragment
+        from pilosa_tpu.storage import fragmentfile
+        from pilosa_tpu.storage.fragmentfile import FragmentFile
+
+        frag = Fragment(n_words=64)
+        store = FragmentFile(frag, str(tmp_path / "frag"))
+        store.open()
+        frag.set_bit(1, 10)
+
+        real_serialize = fragmentfile.roaring.serialize
+        retries = FragmentFile._SNAPSHOT_RETRIES
+        calls = {"n": 0}
+
+        def always_racing(positions):
+            # a new op lands during every LOCK-FREE encode (the final,
+            # lock-held attempt is the (retries+1)-th serialize call and
+            # must not mutate: the caller holds both locks there)
+            calls["n"] += 1
+            if calls["n"] <= retries:
+                frag.set_bit(10 + calls["n"], 5)
+            return real_serialize(positions)
+
+        monkeypatch.setattr(fragmentfile.roaring, "serialize", always_racing)
+        store.snapshot()  # must terminate
+        monkeypatch.setattr(fragmentfile.roaring, "serialize", real_serialize)
+        assert calls["n"] == retries + 1  # every optimistic attempt raced
+        assert store.op_n == 0  # rewrite completed
+        store.close()
