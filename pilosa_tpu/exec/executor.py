@@ -117,6 +117,8 @@ class Executor:
         # pair counts answered from the cached host gram (zero device
         # work — the serving mode for repeat sequential queries)
         self.gram_cache_hits = 0
+        # TopN row-count vectors served from the per-snapshot host cache
+        self.rowcount_cache_hits = 0
 
     # ------------------------------------------------------------------ API
 
@@ -401,6 +403,7 @@ class Executor:
         )
         entry.pop("gram", None)  # cached gram matched the old snapshot
         entry.pop("gram_misses", None)  # reuse restarts per snapshot
+        entry.pop("rowcounts", None)  # ditto the served counts vector
         entry["dev"] = dev  # dev before versions: a racing reader keyed on
         entry["versions"] = versions  # versions must never see the old dev
         self.stack_incremental += 1
@@ -438,29 +441,7 @@ class Executor:
         from pilosa_tpu.ops import kernels
 
         R = bits.shape[1]
-        # Find the owning cache entry by snapshot identity rather than by
-        # rebuilding _field_stack's cache key (which would silently go
-        # stale if the key shape ever changed); the cache holds at most a
-        # handful of entries. The budget's _evict pops the dict lock-free
-        # from arbitrary threads, so the scan retries on a mid-iteration
-        # mutation and degrades to a cache miss rather than failing the
-        # query.
-        entry = None
-        caches = getattr(field, "_stack_caches", None)
-        if caches:
-            for _ in range(3):
-                try:
-                    entry = next(
-                        (
-                            e
-                            for e in list(caches.values())
-                            if e.get("dev") is bits
-                        ),
-                        None,
-                    )
-                    break
-                except RuntimeError:
-                    continue  # dict mutated mid-scan; retry then miss
+        entry = self._stack_entry_for(field, bits)
         if entry is not None and R <= self._GRAM_CACHE_MAX_ROWS:
             cached = entry.get("gram")
             if cached is not None and cached[0] is bits:
@@ -504,6 +485,59 @@ class Executor:
             n = vars(field).get("_pair_single_demand", 0) + 1
             field._pair_single_demand = n
         return n >= self._PAIR_SINGLE_WARM
+
+    @staticmethod
+    def _stack_entry_for(field: Field, bits):
+        """The stack-cache entry whose device snapshot IS ``bits``, found
+        by identity rather than by rebuilding _field_stack's cache key
+        (which would silently go stale if the key shape ever changed);
+        the cache holds at most a handful of entries. The budget's _evict
+        pops the dict lock-free from arbitrary threads, so the scan
+        retries on a mid-iteration mutation and degrades to a cache miss
+        rather than failing the query."""
+        caches = getattr(field, "_stack_caches", None)
+        if not caches:
+            return None
+        for _ in range(3):
+            try:
+                return next(
+                    (
+                        e
+                        for e in list(caches.values())
+                        if e.get("dev") is bits
+                    ),
+                    None,
+                )
+            except RuntimeError:
+                continue  # dict mutated mid-scan; retry then miss
+        return None
+
+    def _stack_row_counts(self, field: Field, bits) -> np.ndarray:
+        """Per-slot row counts ``int64 [R]`` for a stack snapshot, cached
+        on the owning cache entry (keyed to the snapshot like the gram) —
+        repeat unfiltered TopN against an unchanged field is then served
+        from host memory with zero device work, the reference's
+        ranked-cache role (cache.go).  A cached full gram's diagonal is
+        reused instead of launching the count kernel."""
+        from pilosa_tpu.ops import kernels
+
+        entry = self._stack_entry_for(field, bits)
+        if entry is not None:
+            cached = entry.get("rowcounts")
+            if cached is not None and cached[0] is bits:
+                self.rowcount_cache_hits += 1
+                return cached[1]
+            gram = entry.get("gram")
+            if gram is not None and gram[0] is bits:
+                rc = np.diag(gram[1]).astype(np.int64)
+            else:
+                rc = np.asarray(kernels.row_counts(bits)).astype(np.int64)
+            lock = vars(field).setdefault("_stack_lock", threading.RLock())
+            with lock:
+                if entry.get("dev") is bits:  # snapshot still current
+                    entry["rowcounts"] = (bits, rc)
+            return rc
+        return np.asarray(kernels.row_counts(bits)).astype(np.int64)
 
     def _batch_pair_counts(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -1555,7 +1589,7 @@ class Executor:
 
                 slot_of, bits = stack
                 if src is None:
-                    rc = np.asarray(kernels.row_counts(bits)).astype(np.int64)
+                    rc = self._stack_row_counts(field, bits)
                     for rid, slot in slot_of.items():
                         if rc[slot]:
                             counts[rid] = int(rc[slot])
@@ -1567,9 +1601,7 @@ class Executor:
                         if mc[slot]:
                             counts[rid] = int(mc[slot])
                     if has_tanimoto:
-                        rc = np.asarray(kernels.row_counts(bits)).astype(
-                            np.int64
-                        )
+                        rc = self._stack_row_counts(field, bits)
                         for rid, slot in slot_of.items():
                             if rc[slot]:
                                 row_totals[rid] = int(rc[slot])

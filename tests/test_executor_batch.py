@@ -372,3 +372,55 @@ class TestSinglePairServing:
         ex.execute("i", f"Set({free}, f=0) Set({free}, f=1)")
         after = ex.execute("i", q)[0]
         assert after == before + 1
+
+
+class TestTopNServing:
+    """Repeat unfiltered TopN against an unchanged field must be served
+    from the cached per-snapshot row-counts vector (or a cached gram's
+    diagonal) with zero device work — the reference's ranked cache
+    serving TopN from memory (cache.go)."""
+
+    def test_topn_served_after_first_stack_query(self, setup):
+        _, ex = setup
+        want = ex.execute("i", "TopN(f, n=4)")[0]
+        hits = ex.rowcount_cache_hits
+        for _ in range(3):
+            assert ex.execute("i", "TopN(f, n=4)")[0] == want
+        assert ex.rowcount_cache_hits >= hits + 3
+
+    def test_topn_counts_match_gram_diagonal(self, setup, monkeypatch):
+        """When a full gram is already cached (and no counts vector is),
+        TopN must reuse the gram's diagonal rather than launching the
+        count kernel — and the answers must equal a cold TopN."""
+        from pilosa_tpu.ops import kernels
+
+        h, ex = setup
+        cold = ex.execute("i", "TopN(f, n=6)")[0]
+        # install the full gram via repeat batched pair-count queries
+        q = _pairs_query([(a, b) for a in range(3) for b in range(3)])
+        for _ in range(3):
+            ex.execute("i", q)
+        # drop the counts vector the first TopN cached, so the next TopN
+        # must re-derive it — from the gram diagonal, never the kernel
+        field = h.index("i").field("f")
+        entries = list(vars(field)["_stack_caches"].values())
+        assert any(e.pop("rowcounts", None) for e in entries)
+        assert any(e.get("gram") for e in entries)
+        monkeypatch.setattr(
+            kernels,
+            "row_counts",
+            lambda *a, **k: pytest.fail(
+                "TopN must serve from the cached gram diagonal"
+            ),
+        )
+        assert ex.execute("i", "TopN(f, n=6)")[0] == cold
+
+    def test_write_invalidates_served_topn(self, setup):
+        _, ex = setup
+        before = ex.execute("i", "TopN(f, n=1)")[0]
+        ex.execute("i", "TopN(f, n=1)")  # cache the counts vector
+        top_row, top_count = before[0].id, before[0].count
+        free = 900_001
+        ex.execute("i", f"Set({free}, f={top_row})")
+        after = ex.execute("i", "TopN(f, n=1)")[0]
+        assert after[0].id == top_row and after[0].count == top_count + 1
