@@ -119,6 +119,8 @@ class Executor:
         self.gram_cache_hits = 0
         # TopN row-count vectors served from the per-snapshot host cache
         self.rowcount_cache_hits = 0
+        # GroupBy combination matrices served from the cached cross gram
+        self.crossgram_cache_hits = 0
 
     # ------------------------------------------------------------------ API
 
@@ -404,6 +406,8 @@ class Executor:
         entry.pop("gram", None)  # cached gram matched the old snapshot
         entry.pop("gram_misses", None)  # reuse restarts per snapshot
         entry.pop("rowcounts", None)  # ditto the served counts vector
+        entry.pop("crossgram", None)  # ditto the cross-field gram
+        entry.pop("crossgram_misses", None)
         entry["dev"] = dev  # dev before versions: a racing reader keyed on
         entry["versions"] = versions  # versions must never see the old dev
         self.stack_incremental += 1
@@ -538,6 +542,102 @@ class Executor:
                     entry["rowcounts"] = (bits, rc)
             return rc
         return np.asarray(kernels.row_counts(bits)).astype(np.int64)
+
+    # live cross-gram slots kept per stack entry (one per partner field);
+    # each full gram is <= 8 MiB host memory at _GRAM_CACHE_MAX_ROWS
+    _CROSS_GRAM_SLOTS = 4
+
+    def _cross_slot(self, field: Field, bits, partner: str):
+        """The (own_bits, partner_weakref, gram) slot cached on
+        ``field``'s stack entry for ``partner``, dropping it if stale.
+        Also returns the owning entry (or None)."""
+        entry = self._stack_entry_for(field, bits)
+        if entry is None:
+            return None, None
+        slots = entry.get("crossgram")
+        t = slots.get(partner) if slots else None
+        if t is not None:
+            lock = vars(field).setdefault("_stack_lock", threading.RLock())
+            if not (t[0] is bits and t[1]() is not None):
+                # our snapshot moved, or the partner's was retired/
+                # evicted — drop the slot now rather than letting it
+                # linger
+                with lock:
+                    slots.pop(partner, None)
+                t = None
+            else:
+                # LRU: move the hit slot to the end so the eviction loop
+                # (which pops from the front) removes the coldest partner
+                with lock:
+                    cur = slots.pop(partner, None)
+                    if cur is not None:
+                        slots[partner] = cur
+        return entry, t
+
+    def _cross_gram(
+        self, f1: Field, bits1, f2: Field, bits2, sub1: list, sub2: list
+    ):
+        """Cross-field intersection counts ``int64 [len(sub1), len(sub2)]``
+        for two stack snapshots, with the same invest-on-reuse caching as
+        ``_field_gram``: once repeat 2-level GroupBys against unchanged
+        fields prove reuse, the FULL cross gram is computed once and every
+        later combination matrix is sliced from host memory with zero
+        device work.  Slots live on the first field's stack entry, one per
+        partner field (so alternating partners don't thrash), and hold the
+        partner's snapshot only WEAKLY — a cached gram must never keep a
+        retired or budget-evicted device stack alive.  None when the gram
+        path declines."""
+        from pilosa_tpu.ops import kernels
+
+        R1, R2 = bits1.shape[1], bits2.shape[1]
+        if (
+            R1 <= self._GRAM_CACHE_MAX_ROWS
+            and R2 <= self._GRAM_CACHE_MAX_ROWS
+        ):
+            entry, t = self._cross_slot(f1, bits1, f2.name)
+            if t is not None and t[1]() is bits2:
+                self.crossgram_cache_hits += 1
+                return t[2][np.ix_(sub1, sub2)]
+            # the reversed field order may already hold this gram
+            # transposed (GroupBy(f, g) then GroupBy(g, f))
+            _, t2 = self._cross_slot(f2, bits2, f1.name)
+            if t2 is not None and t2[1]() is bits1:
+                self.crossgram_cache_hits += 1
+                return t2[2].T[np.ix_(sub1, sub2)]
+            if entry is not None:
+                misses = entry.setdefault("crossgram_misses", {})
+                nearly_full = 2 * len(sub1) >= R1 and 2 * len(sub2) >= R2
+                if (
+                    nearly_full
+                    or misses.get(f2.name, 0) >= self._GRAM_CACHE_MIN_REUSE
+                ):
+                    g = kernels.cross_pair_gram(
+                        bits1, bits2, list(range(R1)), list(range(R2))
+                    )
+                    if g is not None:
+                        lock = vars(f1).setdefault(
+                            "_stack_lock", threading.RLock()
+                        )
+                        with lock:
+                            if entry.get("dev") is bits1:  # still current
+                                slots = entry.setdefault("crossgram", {})
+                                # pop-then-insert so an overwrite lands
+                                # at the end (freshest LRU position)
+                                slots.pop(f2.name, None)
+                                slots[f2.name] = (
+                                    bits1,
+                                    weakref.ref(bits2),
+                                    g,
+                                )
+                                while len(slots) > self._CROSS_GRAM_SLOTS:
+                                    k = next(iter(slots), None)
+                                    if k is None:
+                                        break
+                                    slots.pop(k, None)
+                        return g[np.ix_(sub1, sub2)]
+                else:
+                    misses[f2.name] = misses.get(f2.name, 0) + 1
+        return kernels.cross_pair_gram(bits1, bits2, sub1, sub2)
 
     def _batch_pair_counts(
         self, idx: Index, calls: list[Call], shards: list[int] | None,
@@ -1879,8 +1979,10 @@ class Executor:
                     pb = np.array([pos[slot1[r]] for r in present2])
                     counts2d = g[np.ix_(pa, pb)]
             else:
-                counts2d = kernels.cross_pair_gram(
+                counts2d = self._cross_gram(
+                    f1,
                     bits1,
+                    f2,
                     bits2,
                     [slot1[r] for r in present1],
                     [slot2[r] for r in present2],

@@ -424,3 +424,103 @@ class TestTopNServing:
         ex.execute("i", f"Set({free}, f={top_row})")
         after = ex.execute("i", "TopN(f, n=1)")[0]
         assert after[0].id == top_row and after[0].count == top_count + 1
+
+
+class TestGroupByCrossGramServing:
+    """Repeat 2-level GroupBy across two unchanged fields must invest in
+    the full cross-field gram once and then serve every combination
+    matrix from host memory (zero device work per query)."""
+
+    def test_repeat_groupby_served_from_cross_gram(self, setup):
+        _, ex = setup
+        q = "GroupBy(Rows(f), Rows(g))"
+        want = ex.execute("i", q)[0]
+        # warm past the observed-reuse investment gate
+        for _ in range(ex._GRAM_CACHE_MIN_REUSE + 2):
+            assert ex.execute("i", q)[0] == want
+        hits = ex.crossgram_cache_hits
+        for _ in range(3):
+            assert ex.execute("i", q)[0] == want
+        assert ex.crossgram_cache_hits >= hits + 3
+        # the reversed field order must serve from the SAME cached gram,
+        # transposed, without a second device investment
+        hits = ex.crossgram_cache_hits
+        rev = {
+            tuple(sorted((fr.field, fr.row_id) for fr in gc.group)): gc.count
+            for gc in ex.execute("i", "GroupBy(Rows(g), Rows(f))")[0]
+        }
+        fwd = {
+            tuple(sorted((fr.field, fr.row_id) for fr in gc.group)): gc.count
+            for gc in ex.execute("i", q)[0]
+        }
+        assert rev == fwd
+        assert ex.crossgram_cache_hits >= hits + 2
+
+    def test_write_to_second_field_invalidates(self, setup):
+        """The cross gram is keyed to BOTH snapshots: a write to the
+        second field must be visible immediately."""
+        h, ex = setup
+        q = "GroupBy(Rows(f), Rows(g))"
+        for _ in range(ex._GRAM_CACHE_MIN_REUSE + 3):
+            before = {
+                tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+                for gc in ex.execute("i", q)[0]
+            }
+        # find a column in f row 0 not in g row 0, add it to g row 0
+        row_f0 = ex.execute("i", "Row(f=0)")[0].columns()
+        row_g0 = set(ex.execute("i", "Row(g=0)")[0].columns())
+        new_col = next(int(c) for c in row_f0 if int(c) not in row_g0)
+        ex.execute("i", f"Set({new_col}, g=0)")
+        after = {
+            tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+            for gc in ex.execute("i", q)[0]
+        }
+        key = (("f", 0), ("g", 0))
+        assert after[key] == before[key] + 1
+
+    def test_alternating_partners_keep_separate_slots(self, setup):
+        """GroupBy(f, g) alternating with GroupBy(f, h) must keep one
+        cached gram per partner — no thrash, no per-query full-device
+        recompute."""
+        h_, ex = setup
+        idx = h_.index("i")
+        idx.create_field("h")
+        rng = np.random.default_rng(7)
+        writes = []
+        for row in range(3):
+            for col in rng.integers(0, 2 * h_.n_words * 32, size=25):
+                writes.append(f"Set({int(col)}, h={row})")
+        ex.execute("i", " ".join(writes))
+        qa, qb = "GroupBy(Rows(f), Rows(g))", "GroupBy(Rows(f), Rows(h))"
+        wa = ex.execute("i", qa)[0]
+        wb = ex.execute("i", qb)[0]
+        for _ in range(ex._GRAM_CACHE_MIN_REUSE + 2):
+            assert ex.execute("i", qa)[0] == wa
+            assert ex.execute("i", qb)[0] == wb
+        hits = ex.crossgram_cache_hits
+        for _ in range(3):
+            assert ex.execute("i", qa)[0] == wa
+            assert ex.execute("i", qb)[0] == wb
+        assert ex.crossgram_cache_hits >= hits + 6  # both served
+
+    def test_cached_cross_gram_does_not_pin_partner_stack(self, setup):
+        """The slot holds the partner snapshot weakly: dropping the
+        partner's stack entry must let its device array die, and the
+        next GroupBy must recompute correctly."""
+        import gc
+        import weakref as wr
+
+        h_, ex = setup
+        q = "GroupBy(Rows(f), Rows(g))"
+        want = ex.execute("i", q)[0]
+        for _ in range(ex._GRAM_CACHE_MIN_REUSE + 2):
+            ex.execute("i", q)
+        g_field = h_.index("i").field("g")
+        caches = vars(g_field)["_stack_caches"]
+        [gentry] = list(caches.values())
+        ref = wr.ref(gentry["dev"])
+        caches.clear()  # budget-evict g's stack entry
+        del gentry
+        gc.collect()
+        assert ref() is None  # nothing pins the retired device stack
+        assert ex.execute("i", q)[0] == want  # recomputes, still right
