@@ -724,7 +724,12 @@ class Executor:
                 # gram declined (too many distinct rows): scan kernels,
                 # one launch per op, padded to powers of two for program
                 # reuse.  [B, S] per-shard partials summed host-side in
-                # int64 so totals past 2^31 stay exact.
+                # int64 so totals past 2^31 stay exact.  The scan
+                # kernels' partials are not host addressable on a
+                # process-spanning stack — those items stay unset and
+                # the ordinary per-call path serves them.
+                if kernels.stack_spans_processes(bits):
+                    continue
                 by_op: dict[str, list[tuple[int, int, int]]] = {}
                 for i, op, sa, sb in launch:
                     by_op.setdefault(op, []).append((i, sa, sb))
@@ -872,6 +877,13 @@ class Executor:
             real = next((a for a in out if a is not None), None)
             if real is None:
                 return None  # every leaf view absent
+            # the compiled programs return per-shard partials, which are
+            # not host addressable on a process-spanning stack — decline
+            # and let the per-call path serve
+            from pilosa_tpu.ops import kernels
+
+            if kernels.stack_spans_processes(real):
+                return None
             return tuple(a if a is not None else real for a in out), slot_maps
 
         def _slots_of(leaves, slot_maps) -> np.ndarray:
@@ -1683,10 +1695,19 @@ class Executor:
             # filtered via the masked-count kernel (replacing the
             # reference's per-fragment cache merge and the per-shard
             # filter loop, fragment.go:1586-1655).
+            from pilosa_tpu.ops import kernels
+
             stack = self._field_stack(field, shards)
             if stack is not None:
-                from pilosa_tpu.ops import kernels
-
+                # masked counts aren't supported on process-spanning
+                # stacks (nor plain counts past their int32 bound);
+                # the per-fragment loop below answers instead
+                if (
+                    src is not None
+                    and kernels.stack_spans_processes(stack[1])
+                ) or not kernels.row_counts_supported(stack[1]):
+                    stack = None
+            if stack is not None:
                 slot_of, bits = stack
                 if src is None:
                     rc = self._stack_row_counts(field, bits)
@@ -1990,6 +2011,11 @@ class Executor:
             if counts2d is not None:
                 counts = counts2d.reshape(-1)
             else:
+                # the batched scan kernels below can't run on a
+                # process-spanning stack (non-addressable partials);
+                # decline to the recursive per-fragment engine instead
+                if kernels.stack_spans_processes(bits1):
+                    return None
                 combos_s = [
                     (slot1[r1], slot2[r2])
                     for r1 in present1
@@ -2060,6 +2086,10 @@ class Executor:
                 return None
             stacks.append(st)
         slot0, bits0 = stacks[0]
+        if kernels.stack_spans_processes(bits0):
+            # combo-count kernels return per-shard partials, not host
+            # addressable on a spanning stack; recursive path serves
+            return None
         S, _, W = bits0.shape
         cmax = max(1, self._GROUPBY_PREFIX_BUDGET_BYTES // (S * W * 4))
 

@@ -191,20 +191,34 @@ def _pair_count_sharded_fn(mesh, axis, op, two_tensor):
 
 
 @lru_cache(maxsize=64)
-def _row_counts_sharded_fn(mesh, axis, use_pallas):
-    """jit(shard_map) per-shard row popcounts over a shards-sharded stack
-    -> int32[S, R] laid out along the mesh axis."""
-    local = (
-        row_counts_per_shard_pallas if use_pallas else row_counts_per_shard_xla
-    )
+def _row_counts_mesh_fn(mesh, axis, use_pallas, in_program_reduce):
+    """jit(shard_map) row popcounts over a shards-sharded stack — per-
+    shard int32[S, R] partials along the mesh axis for a host-side sum,
+    or an in-program psum reduce to a replicated int32[R] for
+    process-spanning meshes (XLA local only there; same two modes as
+    _gram_mesh_fn)."""
+    if in_program_reduce:
+        local = lambda b: lax.psum(row_counts_xla(b), axis)
+        out_specs = P(None)
+    else:
+        local = (
+            row_counts_per_shard_pallas
+            if use_pallas
+            else row_counts_per_shard_xla
+        )
+        out_specs = P(axis, None)
     return jax.jit(
         shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis, None, None),),
-            out_specs=P(axis, None),
+            out_specs=out_specs,
         )
     )
+
+
+def _row_counts_sharded_fn(mesh, axis, use_pallas):
+    return _row_counts_mesh_fn(mesh, axis, use_pallas, False)
 
 
 def _run_sharded(builder, builder_args, call_args) -> jax.Array:
@@ -264,6 +278,16 @@ def pair_count_batched(
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
+        if mesh_spans_processes(mesh):
+            # the [B, S] per-shard partials this kernel returns are not
+            # host addressable on a spanning mesh; pair counts there are
+            # supported only through pair_gram's psum reduce, which is
+            # itself bounded at GRAM_MAX_ROWS distinct rows per batch
+            raise NotImplementedError(
+                "pair_count_batched on a process-spanning mesh;"
+                " process-spanning stacks answer pair counts via"
+                f" pair_gram only (<= {GRAM_MAX_ROWS} distinct rows)"
+            )
         return _pair_count_sharded_fn(mesh, axis, op, False)(bits, ras, rbs)
     return pair_count_batched_xla(bits, ras, rbs, op=op)
 
@@ -354,28 +378,170 @@ def _gram_int32_safe(s: int, w: int) -> bool:
     return s * w * 32 <= _GRAM_ACC_LIMIT
 
 
+def row_counts_supported(bits) -> bool:
+    """Whether ``row_counts`` can serve this stack — always, except a
+    process-spanning mesh so large that even a single-shard-per-device
+    psum slice would overflow int32 (callers decline to per-fragment
+    counting instead of catching row_counts' ValueError)."""
+    m = shards_axis_of(bits)
+    if m is None or not mesh_spans_processes(m[0]):
+        return True
+    S, _, W = bits.shape
+    return _gram_int32_safe(S, W) or _psum_chunk_size(m[0], W) >= 1
+
+
+def stack_spans_processes(x) -> bool:
+    """Whether ``x`` is a shards-sharded stack whose mesh includes other
+    processes' devices.  The decline guard for every batched path whose
+    kernels return per-shard partials (not host addressable there):
+    callers fall through to per-fragment serving instead."""
+    m = shards_axis_of(x)
+    return m is not None and mesh_spans_processes(m[0])
+
+
 @lru_cache(maxsize=64)
-def _gram_sharded_fn(mesh, axis, gather):
-    """jit(shard_map): per-device local gram partials stacked along the
-    mesh axis -> [n_dev, R, R]; the host sums them in int64 (the ICI
-    replacement for the reference's mapReduce reduce step)."""
+def mesh_spans_processes(mesh) -> bool:
+    """Whether the mesh includes devices owned by other processes — the
+    multi-host serving layout, where per-device partials are NOT host
+    addressable and the reduce must happen in-program.  Cached: the
+    answer is constant per mesh and this sits on ~0.1 ms serving
+    paths."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+@lru_cache(maxsize=64)
+def _gram_mesh_fn(mesh, axis, gather, in_program_reduce):
+    """jit(shard_map) gram over a shards-sharded stack.  Two reduce
+    modes: per-device partials stacked along the mesh axis for a
+    host-side int64 sum (single-host serving), or an IN-PROGRAM
+    ``lax.psum`` whose reduce rides the runtime's collectives (ICI
+    within a host, DCN across — SURVEY §2.4's mapping of the
+    reference's mapReduce reduce step, executor.go:2454) and whose
+    result is replicated on every process — required when the mesh
+    spans processes, where stacked partials would not be host
+    addressable."""
     if gather:
-        local = lambda b, i: gram_gather_xla(b, i)[None]
+        base = lambda b, i: gram_gather_xla(b, i)
         in_specs = (P(axis, None, None), P(None))
     else:
-        local = lambda b: gram_matrix_xla(b)[None]
+        base = lambda b: gram_matrix_xla(b)
         in_specs = (P(axis, None, None),)
+    if in_program_reduce:
+        local = lambda *a: lax.psum(base(*a), axis)
+        out_specs = P(None, None)
+    else:
+        local = lambda *a: base(*a)[None]
+        out_specs = P(axis, None, None)
     return jax.jit(
         shard_map(
             local,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=P(axis, None, None),
+            out_specs=out_specs,
             # the gram scan's zero-init carry is replicated while the
             # shard blocks vary per device; the accumulation is still
             # purely local so the vma check is safe to relax
             check_vma=False,
         )
+    )
+
+
+def _gram_sharded_fn(mesh, axis, gather):
+    return _gram_mesh_fn(mesh, axis, gather, False)
+
+
+def _carry_psum_chunks(local_partial, arrs, axis, chunk):
+    """In-program exact accumulation past int32: loop the device-local
+    shard block in ``chunk``-shard slices, psum each slice's int32
+    partial across the mesh axis, and accumulate into a (hi, lo) uint32
+    carry-save pair (device int64 is unavailable without x64).  The
+    caller picks ``chunk`` so one slice's GLOBAL psum total is
+    int32-exact."""
+    s_loc = arrs[0].shape[0]
+    n_chunks = -(-s_loc // chunk)
+    pad = n_chunks * chunk - s_loc
+    arrs = tuple(
+        jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1)) for a in arrs
+    )
+    shape = jax.eval_shape(
+        local_partial,
+        *(
+            jax.ShapeDtypeStruct((chunk,) + a.shape[1:], a.dtype)
+            for a in arrs
+        ),
+    ).shape
+
+    def body(i, acc):
+        hi, lo = acc
+        blks = tuple(
+            lax.dynamic_slice_in_dim(a, i * chunk, chunk, 0) for a in arrs
+        )
+        p = lax.psum(local_partial(*blks), axis).astype(jnp.uint32)
+        new_lo = lo + p
+        # p < 2^32, so the add wrapped iff the result went down
+        hi = hi + (new_lo < lo).astype(jnp.uint32)
+        return hi, new_lo
+
+    z = jnp.zeros(shape, jnp.uint32)
+    return lax.fori_loop(0, n_chunks, body, (z, z))
+
+
+@lru_cache(maxsize=64)
+def _psum_chunked_fn(mesh, axis, kind, chunk):
+    """jit(shard_map) for process-spanning meshes whose totals exceed
+    int32: returns replicated (hi, lo) uint32 arrays to combine on host
+    as hi * 2^32 + lo."""
+    if kind == "gram":
+        local = lambda b: _carry_psum_chunks(
+            gram_matrix_xla, (b,), axis, chunk
+        )
+        in_specs = (P(axis, None, None),)
+        out = P(None, None)
+    elif kind == "gram_gather":
+        local = lambda b, i: _carry_psum_chunks(
+            lambda blk: gram_gather_xla(blk, i), (b,), axis, chunk
+        )
+        in_specs = (P(axis, None, None), P(None))
+        out = P(None, None)
+    elif kind == "cross":
+        local = lambda a, b, ia, ib: _carry_psum_chunks(
+            lambda x, y: cross_gram_xla(x[:, ia], y[:, ib]),
+            (a, b),
+            axis,
+            chunk,
+        )
+        in_specs = (
+            P(axis, None, None), P(axis, None, None), P(None), P(None)
+        )
+        out = P(None, None)
+    else:  # rows
+        local = lambda b: _carry_psum_chunks(
+            row_counts_xla, (b,), axis, chunk
+        )
+        in_specs = (P(axis, None, None),)
+        out = P(None)
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(out, out),
+            check_vma=False,
+        )
+    )
+
+
+def _psum_chunk_size(mesh, w: int) -> int:
+    """Per-device shards per chunked psum so one slice's global total
+    stays int32-exact; 0 when even a single shard per device overflows
+    (callers decline)."""
+    return _GRAM_ACC_LIMIT // max(1, mesh.devices.size * w * 32)
+
+
+def _hi_lo_total(hi, lo) -> np.ndarray:
+    return np.asarray(hi).astype(np.int64) * 2**32 + np.asarray(lo).astype(
+        np.int64
     )
 
 
@@ -385,10 +551,14 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     one-launch answer to a whole batch of pair-count queries
     (reference executor.go:653-680 + roaring.go:568, re-shaped for the
     MXU).  None when ``row_idx`` is too wide for the gram path
-    (> GRAM_MAX_ROWS); callers fall back to the scan kernels.
+    (> GRAM_MAX_ROWS); callers fall back to the scan kernels — except on
+    a process-spanning mesh, where the scan kernels raise and callers
+    must decline to per-fragment paths instead.
 
     Works on single-device and shards-axis NamedSharding'd stacks; on a
-    mesh each device grams its local shard block and the host reduces.
+    single-host mesh each device grams its local shard block and the
+    host reduces, while a process-spanning mesh reduces in-program
+    (psum, carry-save chunked past int32).
     """
     S, R, W = bits.shape
     U = len(row_idx)
@@ -404,6 +574,21 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
+        if mesh_spans_processes(mesh):
+            # multi-host stack: reduce in-program (psum over DCN/ICI) —
+            # per-device partials aren't host addressable here
+            if _gram_int32_safe(S, W):
+                fn = _gram_mesh_fn(mesh, axis, not full, True)
+                out = fn(bits) if full else fn(bits, jnp.asarray(idx))
+                return np.asarray(out).astype(np.int64)[:U, :U]
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                return None
+            fn = _psum_chunked_fn(
+                mesh, axis, "gram_gather" if not full else "gram", chunk
+            )
+            hi, lo = fn(bits) if full else fn(bits, jnp.asarray(idx))
+            return _hi_lo_total(hi, lo)[:U, :U]
         if not _gram_int32_safe(-(-S // mesh.devices.size), W):
             # a device-local partial could wrap int32; callers fall back
             # to the scan kernels' [B, S] per-shard partials
@@ -488,8 +673,17 @@ def cross_gram_gather_xla(
 
 
 @lru_cache(maxsize=64)
-def _cross_gram_sharded_fn(mesh, axis):
-    local = lambda a, b, ia, ib: cross_gram_xla(a[:, ia], b[:, ib])[None]
+def _cross_gram_mesh_fn(mesh, axis, in_program_reduce):
+    """Cross gram over aligned shards-sharded stacks — stacked partials
+    for a host-side sum, or an in-program psum reduce for
+    process-spanning meshes (same two modes as _gram_mesh_fn)."""
+    base = lambda a, b, ia, ib: cross_gram_xla(a[:, ia], b[:, ib])
+    if in_program_reduce:
+        local = lambda *args: lax.psum(base(*args), axis)
+        out_specs = P(None, None)
+    else:
+        local = lambda *args: base(*args)[None]
+        out_specs = P(axis, None, None)
     return jax.jit(
         shard_map(
             local,
@@ -497,10 +691,18 @@ def _cross_gram_sharded_fn(mesh, axis):
             in_specs=(
                 P(axis, None, None), P(axis, None, None), P(None), P(None)
             ),
-            out_specs=P(axis, None, None),
+            out_specs=out_specs,
             check_vma=False,  # same local-accumulation argument as
-        )  # _gram_sharded_fn
+        )  # _gram_mesh_fn
     )
+
+
+def _cross_gram_sharded_fn(mesh, axis):
+    return _cross_gram_mesh_fn(mesh, axis, False)
+
+
+def _cross_gram_psum_fn(mesh, axis):
+    return _cross_gram_mesh_fn(mesh, axis, True)
 
 
 def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
@@ -520,6 +722,20 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:
         mesh, axis = m
+        if mesh_spans_processes(mesh):
+            # in-program psum reduce (see pair_gram's spanning branch)
+            if _gram_int32_safe(S, W):
+                out = _cross_gram_psum_fn(mesh, axis)(
+                    bits_a, bits_b, jnp.asarray(ia), jnp.asarray(ib)
+                )
+                return np.asarray(out).astype(np.int64)[:Ua, :Ub]
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                return None
+            hi, lo = _psum_chunked_fn(mesh, axis, "cross", chunk)(
+                bits_a, bits_b, jnp.asarray(ia), jnp.asarray(ib)
+            )
+            return _hi_lo_total(hi, lo)[:Ua, :Ub]
         if not _gram_int32_safe(-(-S // mesh.devices.size), W):
             return None
         out = _cross_gram_sharded_fn(mesh, axis)(
@@ -570,6 +786,14 @@ def pair_count_two_batched(
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:
         mesh, axis = m
+        if mesh_spans_processes(mesh):
+            # same non-addressable-partials constraint as
+            # pair_count_batched; cross_pair_gram is the spanning path
+            raise NotImplementedError(
+                "pair_count_two_batched on a process-spanning mesh;"
+                " process-spanning stacks answer cross-field counts via"
+                f" cross_pair_gram only (<= {GRAM_MAX_ROWS} rows/side)"
+            )
         return _pair_count_sharded_fn(mesh, axis, op, True)(
             bits_a, bits_b, ras, rbs
         )
@@ -798,6 +1022,10 @@ def masked_row_counts_xla(bits: jax.Array, filt: jax.Array) -> jax.Array:
     )
 
 
+def _row_counts_psum_fn(mesh, axis):
+    return _row_counts_mesh_fn(mesh, axis, False, True)
+
+
 @lru_cache(maxsize=64)
 def _masked_row_counts_sharded_fn(mesh, axis, use_pallas):
     local = masked_row_counts_pallas if use_pallas else masked_row_counts_xla
@@ -818,6 +1046,12 @@ def masked_row_counts(bits: jax.Array, filt: jax.Array):
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
+        if mesh_spans_processes(mesh):
+            raise NotImplementedError(
+                "masked row counts (filtered TopN) are served from"
+                " per-host meshes; process-spanning stacks support"
+                " pair_gram/cross_pair_gram/row_counts"
+            )
         fspec = NamedSharding(mesh, P(axis, None))
         if getattr(filt, "sharding", None) != fspec:
             filt = jax.device_put(np.asarray(filt), fspec)
@@ -846,6 +1080,20 @@ def row_counts(bits: jax.Array):
     host-side)."""
     m = shards_axis_of(bits)
     if m is not None:
+        mesh, axis = m
+        if mesh_spans_processes(mesh):
+            S, _, W = bits.shape
+            if _gram_int32_safe(S, W):
+                out = _row_counts_psum_fn(mesh, axis)(bits)
+                return np.asarray(out).astype(np.int64)
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                raise ValueError(
+                    "row totals exceed int32 even per single psum slice;"
+                    " shrink the shard width or the per-host mesh"
+                )
+            hi, lo = _psum_chunked_fn(mesh, axis, "rows", chunk)(bits)
+            return _hi_lo_total(hi, lo)
         partials = _run_sharded(_row_counts_sharded_fn, m, (bits,))
         return np.asarray(partials).astype(np.int64).sum(axis=0)
     if _int32_safe(bits):

@@ -99,7 +99,100 @@ want = [
     len(vcols),
 ]
 assert total.tolist() == want, (total.tolist(), want)
-print(f"proc{pid} OK {total.tolist()}", flush=True)
+
+# ---- global-mesh device data plane ------------------------------------
+# ONE stack sharded across BOTH processes' devices; the gram's reduce is
+# an in-program psum riding the distributed backend (DCN across hosts,
+# the SURVEY §2.4 mapping of mapReduce's reduce step) — no host-side
+# combine at all, every process reads the replicated result.
+from jax.sharding import Mesh, PartitionSpec as P
+from pilosa_tpu.ops import kernels
+
+R = 5
+W = holder.n_words
+# each process contributes ONLY its own shards' blocks (order along the
+# shard axis is irrelevant to a sum over shards)
+mine = sorted(my_shards)
+local_block = np.zeros((len(mine), R, W), np.uint32)
+for r, c in zip(rows.tolist(), cols.tolist()):
+    s, off = divmod(int(c), width)
+    if s in mine:
+        local_block[mine.index(s), r, off // 32] |= np.uint32(1) << np.uint32(
+            off % 32
+        )
+mesh_g = Mesh(np.array(jax.devices()), ("shards",))
+gbits = multihost_utils.host_local_array_to_global_array(
+    local_block, mesh_g, P("shards", None, None)
+)
+assert kernels.mesh_spans_processes(mesh_g)
+g = kernels.pair_gram(gbits, list(range(R)))
+want_gram = np.array(
+    [
+        [len(byrow.get(a, set()) & byrow.get(b, set())) for b in range(R)]
+        for a in range(R)
+    ],
+    np.int64,
+)
+assert np.array_equal(g, want_gram), (g.tolist(), want_gram.tolist())
+
+# gather (row-subset) psum branch
+sub = [0, 2, 4]
+g_sub = kernels.pair_gram(gbits, sub)
+assert np.array_equal(g_sub, want_gram[np.ix_(sub, sub)])
+
+# row counts via in-program psum (replicated result)
+rc = kernels.row_counts(gbits)
+want_rc = [len(byrow.get(r, set())) for r in range(R)]
+assert rc.tolist() == want_rc, (rc.tolist(), want_rc)
+
+# cross gram across two global stacks (reuse the same stack: the
+# cross kernel path differs from pair_gram's even when a == b)
+xg = kernels.cross_pair_gram(gbits, gbits, sub, [1, 3])
+assert np.array_equal(xg, want_gram[np.ix_(sub, [1, 3])])
+
+# chunked carry-save path: a larger synthetic stack whose totals are
+# declared int32-UNSAFE by shrinking the accumulator limit, forcing
+# per-chunk psums combined as uint32 (hi, lo) pairs
+S2, R2, W2 = 8, 3, 32
+rng2 = np.random.default_rng(7)
+full2 = rng2.integers(0, 2**32, size=(S2, R2, W2), dtype=np.uint64).astype(
+    np.uint32
+)
+my_rows = [s for s in range(S2) if s % 2 == pid]
+local2 = full2[my_rows]
+gbits2 = multihost_utils.host_local_array_to_global_array(
+    local2, mesh_g, P("shards", None, None)
+)
+n_dev = mesh_g.devices.size
+old_limit = kernels._GRAM_ACC_LIMIT
+# one slice of `chunk` shards/device is safe; the full S2 extent is not
+kernels._GRAM_ACC_LIMIT = n_dev * W2 * 32 + 1
+try:
+    # the shrunk limit must actually make the full extent unsafe, or the
+    # four assertions below silently test the plain psum branch
+    assert not kernels._gram_int32_safe(S2, W2)
+    g2 = kernels.pair_gram(gbits2, list(range(R2)))
+    rc2 = kernels.row_counts(gbits2)
+    g2_sub = kernels.pair_gram(gbits2, [0, 2])  # chunked gather kind
+    x2 = kernels.cross_pair_gram(  # chunked cross kind
+        gbits2, gbits2, [0, 2], [1]
+    )
+finally:
+    kernels._GRAM_ACC_LIMIT = old_limit
+# ground truth from the full array (order along the shard axis differs
+# between global layout and full2, but sums are order-invariant)
+bits_of = lambda w: np.unpackbits(
+    np.ascontiguousarray(w).view(np.uint8), bitorder="little"
+)
+rows2 = [bits_of(full2[:, r]) for r in range(R2)]
+want_g2 = np.array(
+    [[int((a & b).sum()) for b in rows2] for a in rows2], np.int64
+)
+assert np.array_equal(g2, want_g2), (g2.tolist(), want_g2.tolist())
+assert rc2.tolist() == [int(a.sum()) for a in rows2]
+assert np.array_equal(g2_sub, want_g2[np.ix_([0, 2], [0, 2])])
+assert np.array_equal(x2, want_g2[np.ix_([0, 2], [1])])
+print(f"proc{pid} OK {total.tolist()} psum-gram OK", flush=True)
 """
 
 
