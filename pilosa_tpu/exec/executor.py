@@ -121,6 +121,8 @@ class Executor:
         self.rowcount_cache_hits = 0
         # GroupBy combination matrices served from the cached cross gram
         self.crossgram_cache_hits = 0
+        # unfiltered BSI Sum/Min/Max scalars served per snapshot
+        self.bsi_agg_cache_hits = 0
 
     # ------------------------------------------------------------------ API
 
@@ -408,6 +410,7 @@ class Executor:
         entry.pop("rowcounts", None)  # ditto the served counts vector
         entry.pop("crossgram", None)  # ditto the cross-field gram
         entry.pop("crossgram_misses", None)
+        entry.pop("bsi_agg", None)  # ditto the BSI aggregate scalars
         entry["dev"] = dev  # dev before versions: a racing reader keyed on
         entry["versions"] = versions  # versions must never see the old dev
         self.stack_incremental += 1
@@ -1312,8 +1315,10 @@ class Executor:
         raise ExecuteError(f"unsupported condition op: {op}")
 
     def _bsi_stack(self, field: Field, shards: list[int]):
-        """(exists[S, W], sign[S, W], planes[S, depth, W]) device views of
-        the field's stacked BSI planes, or None (no view / over budget).
+        """The raw ``uint32[S, depth+2, W]`` stacked BSI tensor (rows:
+        exists=0, sign=1, planes 2..) or None (no view / over budget) —
+        split into views via ``_bsi_split`` only when actually
+        computing, so a cache-served aggregate pays no device dispatch.
         The stack is the same budget-accounted, incrementally-refreshed,
         mesh-sharded cache as standard-view stacks, with the row axis
         pinned to the BSI layout (exists=0, sign=1, planes 2.., reference
@@ -1330,6 +1335,13 @@ class Executor:
         if stack is None:
             return None
         _, bits = stack  # [S, depth+2, W]
+        return bits
+
+    @staticmethod
+    def _bsi_split(bits):
+        """(exists, sign, planes) slices of a raw BSI stack.  Each slice
+        is a device dispatch, so callers split only when they actually
+        compute — a cache-served aggregate never pays it."""
         return bits[:, 0], bits[:, 1], bits[:, 2:]
 
     def _bsi_rows(self, field: Field, shards: list[int], kernel) -> Row:
@@ -1340,7 +1352,7 @@ class Executor:
         out = Row(n_words=self.holder.n_words)
         st = self._bsi_stack(field, shards)
         if st is not None:
-            exists, sign, planes = st
+            exists, sign, planes = self._bsi_split(st)
             self.bsi_stack_launches += 1
             mask = kernel(planes, exists, sign)  # [S, W], one launch
             if getattr(mask, "sharding", None) is not None and len(
@@ -1389,40 +1401,24 @@ class Executor:
 
     def _bsi_agg_shards(self, idx: Index, call: Call, shards: list[int] | None):
         """Shared scaffold for Sum/Min/Max: resolve the BSI field and the
-        optional filter child; returns (field, stacked_tensors_or_None,
-        per_shard_generator).  The stacked form — one
-        (planes[S,d,W], exists, sign, filter) tuple covering every shard
-        — answers the aggregate in one launch; the generator is the
-        per-fragment fallback when the stack declines (over budget)."""
+        optional filter child; returns (field, stacked_or_None,
+        per_shard_generator).  The stacked form is a DEFERRED
+        (raw_bits, filter_row, shards) triple — ``_bsi_tensors``
+        materializes the (planes, exists, sign, filter-words) views on a
+        cache miss, answering the aggregate in one launch; the generator
+        is the per-fragment fallback when the stack declines (over
+        budget)."""
         shards = self._shards_for(idx, shards)
         field = self._bsi_field(idx, call)
         filt = self._sum_filter(idx, call, shards)
         view = field.view(field.bsi_view_name())
 
         stacked = None
-        st = self._bsi_stack(field, shards)
-        if st is not None:
-            exists, sign, planes = st
-            if filt is None:
-                # the kernels compute f = exists & filter, so exists
-                # itself is the identity filter — no index-width upload
-                fw = exists
-            else:
-                # the stack's shard axis is padded to the mesh size;
-                # padded slices have exists == 0, so any filter value
-                # there is inert
-                S_stack = exists.shape[0]
-                fw_np = np.zeros((S_stack, field.n_words), np.uint32)
-                for si, s in enumerate(shards):
-                    seg = filt.segments.get(s)
-                    if seg is not None:
-                        fw_np[si] = np.asarray(seg)
-                sh = getattr(exists, "sharding", None)
-                if sh is not None and len(getattr(sh, "device_set", ())) > 1:
-                    fw = jax.device_put(fw_np, sh)  # co-locate with stack
-                else:
-                    fw = jnp.asarray(fw_np)
-            stacked = (planes, exists, sign, fw)
+        bits = self._bsi_stack(field, shards)
+        if bits is not None:
+            # split + filter materialization deferred to _bsi_tensors:
+            # a cache-served aggregate pays zero device dispatches
+            stacked = (bits, filt, shards)
 
         def per_shard():
             if view is None:
@@ -1442,14 +1438,85 @@ class Executor:
 
         return field, stacked, per_shard()
 
+    def _bsi_agg_cache(self, field: Field, dev, key: str):
+        """Per-snapshot cache of unfiltered BSI aggregate scalars on the
+        BSI stack's cache entry (same identity-keyed, write-invalidated
+        scheme as the gram/row-count serving caches): repeat unfiltered
+        Sum/Min/Max against an unchanged field are host dictionary hits.
+        Returns (cached tuple | None, setter)."""
+        entry = self._stack_entry_for(field, dev)
+        if entry is None:
+            return None, lambda v: None
+        slots = entry.get("bsi_agg")
+        t = slots.get(key) if slots else None
+        if t is not None and t[0] is dev:
+            self.bsi_agg_cache_hits += 1
+            return t[1], lambda v: None
+
+        def put(v):
+            lock = vars(field).setdefault("_stack_lock", threading.RLock())
+            with lock:
+                if entry.get("dev") is dev:  # snapshot still current
+                    entry.setdefault("bsi_agg", {})[key] = (dev, v)
+
+        return None, put
+
+    def _bsi_tensors(self, field: Field, stacked):
+        """Materialize a deferred stacked tuple: split the raw stack and
+        build the filter words (device dispatches — run only on a cache
+        miss)."""
+        bits, filt, shards = stacked
+        exists, sign, planes = self._bsi_split(bits)
+        if filt is None:
+            # the kernels compute f = exists & filter, so exists
+            # itself is the identity filter — no index-width upload
+            fw = exists
+        else:
+            # the stack's shard axis is padded to the mesh size;
+            # padded slices have exists == 0, so any filter value
+            # there is inert
+            S_stack = exists.shape[0]
+            fw_np = np.zeros((S_stack, field.n_words), np.uint32)
+            for si, s in enumerate(shards):
+                seg = filt.segments.get(s)
+                if seg is not None:
+                    fw_np[si] = np.asarray(seg)
+            sh = getattr(exists, "sharding", None)
+            if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+                fw = jax.device_put(fw_np, sh)  # co-locate with stack
+            else:
+                fw = jnp.asarray(fw_np)
+        return planes, exists, sign, fw
+
+    def _bsi_agg_serve(self, field: Field, stacked, key: str, compute):
+        """Serve one stacked aggregate: per-snapshot cache hit for
+        unfiltered queries, else materialize the tensors, run
+        ``compute(planes, exists, sign, fw)``, and install (filtered
+        queries always compute — their result depends on the filter)."""
+        bits, filt, _ = stacked
+        cached, put = (
+            self._bsi_agg_cache(field, bits, key)
+            if filt is None
+            else (None, lambda v: None)
+        )
+        if cached is None:
+            planes, exists, sign, fw = self._bsi_tensors(field, stacked)
+            self.bsi_stack_launches += 1
+            cached = compute(planes, exists, sign, fw)
+            put(cached)
+        return cached
+
     def _execute_sum(self, idx: Index, call: Call, shards: list[int] | None) -> ValCount:
         """reference executor.go:409-442 + executeSumCountShard."""
         field, stacked, tensors = self._bsi_agg_shards(idx, call, shards)
         if stacked is not None:
-            planes, exists, sign, fw = stacked
-            self.bsi_stack_launches += 1
-            total, count = bsi.sum_host(
-                planes, exists, sign, fw, depth=field.bit_depth
+            total, count = self._bsi_agg_serve(
+                field,
+                stacked,
+                "sum",
+                lambda p, e, s, fw: bsi.sum_host(
+                    p, e, s, fw, depth=field.bit_depth
+                ),
             )
             if count == 0:
                 return ValCount()
@@ -1469,11 +1536,13 @@ class Executor:
             # the stacked kernels reduce candidates globally across the
             # shard axis, which IS the per-shard merge (equal extremes
             # accumulate their counts)
-            planes, exists, sign, fw = stacked
-            self.bsi_stack_launches += 1
-            value, count = bsi.min_max_host(
-                planes, exists, sign, fw, depth=field.bit_depth,
-                maximal=maximal,
+            value, count = self._bsi_agg_serve(
+                field,
+                stacked,
+                f"minmax:{maximal}",
+                lambda p, e, s, fw: bsi.min_max_host(
+                    p, e, s, fw, depth=field.bit_depth, maximal=maximal
+                ),
             )
             if count == 0:
                 return ValCount()

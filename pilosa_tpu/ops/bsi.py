@@ -28,6 +28,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -175,13 +176,16 @@ def sum_count(planes, exists, sign, filter_words, *, depth: int):
 def sum_host(planes, exists, sign, filter_words, *, depth: int) -> tuple[int, int]:
     """Host wrapper: exact arbitrary-precision (sum, count) from the
     per-plane device popcounts."""
-    import numpy as np
 
     pos_c, neg_c, count = sum_count(planes, exists, sign, filter_words, depth=depth)
-    pos_c = [int(np.asarray(x).astype(np.int64).sum()) for x in pos_c]
-    neg_c = [int(np.asarray(x).astype(np.int64).sum()) for x in neg_c]
-    total = sum(c << k for k, c in enumerate(pos_c)) - sum(
-        c << k for k, c in enumerate(neg_c)
+    # ONE pull per tensor (a per-plane loop of np.asarray would pay a
+    # host round trip per plane)
+    pos_np = np.asarray(pos_c).astype(np.int64)
+    neg_np = np.asarray(neg_c).astype(np.int64)
+    pos_sums = pos_np.reshape(depth, -1).sum(axis=1) if depth else []
+    neg_sums = neg_np.reshape(depth, -1).sum(axis=1) if depth else []
+    total = sum(int(c) << k for k, c in enumerate(pos_sums)) - sum(
+        int(c) << k for k, c in enumerate(neg_sums)
     )
     return total, int(np.asarray(count).astype(np.int64).sum())
 
@@ -203,35 +207,63 @@ def extreme_mag(planes, candidates, *, depth: int, maximal: bool):
     return jnp.where(nonempty, mag, 0), c
 
 
+@partial(jax.jit, static_argnames=("depth", "maximal"))
+def _min_max_fused(planes, exists, sign, fw, *, depth: int, maximal: bool):
+    """Both sign branches of Min/Max in ONE program: flags, magnitudes,
+    counts, and survivor masks.  The host picks the branch from one
+    scalar pull instead of issuing a sync per decision (each host sync
+    is a full relay round trip on the dev chip)."""
+    f = exists & fw
+    neg = f & sign
+    nonneg = f & ~sign
+    # Branch a = preferred: Max prefers non-negatives (largest
+    # magnitude), Min prefers negatives; the fallback branch takes the
+    # opposite extreme of the magnitude.
+    a, b = (nonneg, neg) if maximal else (neg, nonneg)
+    mag_a, c_a = extreme_mag(planes, a, depth=depth, maximal=True)
+    mag_b, c_b = extreme_mag(planes, b, depth=depth, maximal=False)
+    cnt = lambda c: jnp.sum(lax.population_count(c).astype(jnp.int32))
+    scalars = jnp.stack(
+        [
+            jnp.any(a != 0).astype(jnp.int32),
+            jnp.any(b != 0).astype(jnp.int32),
+            mag_a.astype(jnp.int32),
+            cnt(c_a),
+            mag_b.astype(jnp.int32),
+            cnt(c_b),
+        ]
+    )
+    return scalars, c_a, c_b
+
+
 def min_max_host(planes, exists, sign, filter_words, *, depth: int, maximal: bool):
     """Host wrapper for Min/Max (reference fragment.go:1152-1225 minUnsigned/
     maxUnsigned + sign handling): returns (stored_value, count) or
-    (0, 0) when no column matches."""
-    f = jnp.asarray(exists) & jnp.asarray(filter_words)
-    neg = f & jnp.asarray(sign)
-    nonneg = f & ~jnp.asarray(sign)
-    has_neg = bool(jnp.any(neg != 0))
-    has_nonneg = bool(jnp.any(nonneg != 0))
-    if not has_neg and not has_nonneg:
+    (0, 0) when no column matches.  One launch, one host pull (the
+    survivor masks are pulled only for the depth >= 31 exact-magnitude
+    recompute)."""
+    scalars, c_a, c_b = _min_max_fused(
+        jnp.asarray(planes),
+        jnp.asarray(exists),
+        jnp.asarray(sign),
+        jnp.asarray(filter_words),
+        depth=depth,
+        maximal=maximal,
+    )
+    has_a, has_b, mag_a, cnt_a, mag_b, cnt_b = (
+        np.asarray(scalars).tolist()  # ONE host pull for every decision
+    )
+    if not has_a and not has_b:
         return 0, 0
-    if maximal:
-        # Max: prefer non-negatives (largest magnitude); else negatives
-        # (smallest magnitude).
-        if has_nonneg:
-            mag, c = extreme_mag(planes, nonneg, depth=depth, maximal=True)
-            value = _exact_mag(planes, c, depth, int(mag))
-        else:
-            mag, c = extreme_mag(planes, neg, depth=depth, maximal=False)
-            value = -_exact_mag(planes, c, depth, int(mag))
-    else:
-        if has_neg:
-            mag, c = extreme_mag(planes, neg, depth=depth, maximal=True)
-            value = -_exact_mag(planes, c, depth, int(mag))
-        else:
-            mag, c = extreme_mag(planes, nonneg, depth=depth, maximal=False)
-            value = _exact_mag(planes, c, depth, int(mag))
-    count = int(jnp.sum(lax.population_count(c).astype(jnp.int32)))
-    return value, count
+    # branch a's sign is + for Max (non-negatives), - for Min (negatives)
+    a_positive = maximal
+    if has_a:
+        value = _exact_mag(planes, c_a, depth, int(mag_a))
+        value = value if a_positive else -value
+        return value, int(cnt_a)
+    value = _exact_mag(planes, c_b, depth, int(mag_b))
+    value = -value if a_positive else value
+    return value, int(cnt_b)
 
 
 def _exact_mag(planes, survivors, depth: int, approx: int) -> int:
@@ -239,7 +271,6 @@ def _exact_mag(planes, survivors, depth: int, approx: int) -> int:
     exact magnitude from one surviving column on the host."""
     if depth < 31:
         return approx
-    import numpy as np
 
     surv = np.asarray(survivors)
     s = None

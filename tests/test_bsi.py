@@ -286,3 +286,65 @@ class TestBSIStacks:
         bsi_keys = [k for k in f._stack_caches if k[3] is not None]
         assert len(bsi_keys) == 1  # old-depth entry purged
         assert bsi_keys[0] not in keys_before
+
+
+class TestBSIAggServing:
+    """Repeat unfiltered Sum/Min/Max against an unchanged field must be
+    served from the per-snapshot scalar cache with zero device work
+    (the same ranked-cache analogue as the gram/row-count caches)."""
+
+    @pytest.fixture()
+    def ex3(self):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+        from pilosa_tpu.core.field import FieldOptions
+
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field(
+            "v", FieldOptions(field_type="int", min_=-500, max_=500)
+        )
+        ex = Executor(h)
+        rng = np.random.default_rng(23)
+        self.vals = {}
+        width = h.n_words * 32
+        for col in rng.choice(2 * width, size=120, replace=False):
+            v = int(rng.integers(-500, 500))
+            self.vals[int(col)] = v
+            ex.execute("i", f"Set({int(col)}, v={v})")
+        return h, ex
+
+    def test_repeat_aggregates_served_without_launches(self, ex3):
+        _, ex = ex3
+        first = ex.execute("i", "Sum(field=v)Min(field=v)Max(field=v)")
+        launches = ex.bsi_stack_launches
+        hits = ex.bsi_agg_cache_hits
+        for _ in range(3):
+            again = ex.execute("i", "Sum(field=v)Min(field=v)Max(field=v)")
+            assert again == first
+        assert ex.bsi_stack_launches == launches  # no further device work
+        assert ex.bsi_agg_cache_hits >= hits + 9
+
+    def test_write_invalidates_cached_aggregates(self, ex3):
+        _, ex = ex3
+        before = ex.execute("i", "Sum(field=v)")[0]
+        ex.execute("i", "Sum(field=v)")  # cache it
+        free = next(
+            c for c in range(10_000) if c not in self.vals
+        )
+        ex.execute("i", f"Set({free}, v=7)")
+        after = ex.execute("i", "Sum(field=v)")[0]
+        assert after.value == before.value + 7
+        assert after.count == before.count + 1
+
+    def test_filtered_sum_bypasses_cache(self, ex3):
+        _, ex = ex3
+        ex.execute("i", "Sum(field=v)")
+        ex.execute("i", "Sum(field=v)")  # cached now
+        some = sorted(self.vals)[:40]
+        filt_rows = " ".join(f"Set({c}, f=1)" for c in some)
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", filt_rows)
+        got = ex.execute("i", "Sum(Row(f=1), field=v)")[0]
+        assert got.value == sum(self.vals[c] for c in some)
+        assert got.count == len(some)
