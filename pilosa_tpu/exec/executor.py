@@ -1375,11 +1375,43 @@ class Executor:
 
     # ------------------------------------------------------------ aggregates
 
+    def _range_count_key(self, idx: Index, child: Call):
+        """(field, cache key) when ``child`` is a pure BSI range
+        predicate — the repeat-dashboard shape ``Count(Range(v < N))``
+        whose answer is a per-snapshot scalar; None otherwise."""
+        if child.name not in ("Row", "Range") or child.children:
+            return None
+        fname = child.field_arg()
+        if fname is None or set(child.args) != {fname}:
+            return None
+        field = idx.field(fname)
+        if field is None or not field.is_bsi():
+            return None
+        cond = child.args.get(fname)
+        if not isinstance(cond, Condition):
+            return None
+        v = cond.value
+        if isinstance(v, list):
+            v = tuple(v)
+        return field, f"rangecount:{cond.op}:{v!r}"
+
     def _execute_count(self, idx: Index, call: Call, shards: list[int] | None) -> int:
         if len(call.children) != 1:
             raise ExecuteError("Count() takes one argument")
-        row = self._bitmap_call(idx, call.children[0], self._shards_for(idx, shards))
-        return row.count()
+        child = call.children[0]
+        shard_list = self._shards_for(idx, shards)
+        keyed = self._range_count_key(idx, child)
+        if keyed is not None:
+            field, key = keyed
+            bits = self._bsi_stack(field, shard_list)
+            if bits is not None:
+                cached, put = self._bsi_agg_cache(field, bits, key)
+                if cached is not None:
+                    return cached
+                n = self._bitmap_call(idx, child, shard_list).count()
+                put(n)
+                return n
+        return self._bitmap_call(idx, child, shard_list).count()
 
     def _sum_filter(self, idx: Index, call: Call, shards: list[int]):
         if len(call.children) > 1:
@@ -1438,6 +1470,10 @@ class Executor:
 
         return field, stacked, per_shard()
 
+    # scalar aggregates kept per BSI stack snapshot (sum + min/max +
+    # repeat range-count bounds; each entry is a handful of ints)
+    _BSI_AGG_SLOTS = 128
+
     def _bsi_agg_cache(self, field: Field, dev, key: str):
         """Per-snapshot cache of unfiltered BSI aggregate scalars on the
         BSI stack's cache entry (same identity-keyed, write-invalidated
@@ -1451,13 +1487,30 @@ class Executor:
         t = slots.get(key) if slots else None
         if t is not None and t[0] is dev:
             self.bsi_agg_cache_hits += 1
+            # LRU: move the hit key to the dict end so put()'s bounded
+            # eviction (front-first) removes the coldest key, not a hot
+            # one that happened to be inserted early
+            lock = vars(field).setdefault("_stack_lock", threading.RLock())
+            with lock:
+                cur = slots.pop(key, None)
+                if cur is not None:
+                    slots[key] = cur
             return t[1], lambda v: None
 
         def put(v):
             lock = vars(field).setdefault("_stack_lock", threading.RLock())
             with lock:
                 if entry.get("dev") is dev:  # snapshot still current
-                    entry.setdefault("bsi_agg", {})[key] = (dev, v)
+                    slots2 = entry.setdefault("bsi_agg", {})
+                    slots2.pop(key, None)  # re-insert at the LRU end
+                    slots2[key] = (dev, v)
+                    # range-count keys are open-ended (one per distinct
+                    # bound); bound the dict, oldest first
+                    while len(slots2) > self._BSI_AGG_SLOTS:
+                        k = next(iter(slots2), None)
+                        if k is None:
+                            break
+                        slots2.pop(k, None)
 
         return None, put
 

@@ -348,3 +348,79 @@ class TestBSIAggServing:
         got = ex.execute("i", "Sum(Row(f=1), field=v)")[0]
         assert got.value == sum(self.vals[c] for c in some)
         assert got.count == len(some)
+
+
+class TestRangeCountServing:
+    """Repeat Count(Range(v < N)) — the dashboard histogram shape — must
+    be served from the per-snapshot scalar cache after its first
+    compute."""
+
+    @pytest.fixture()
+    def ex2(self):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+        from pilosa_tpu.core.field import FieldOptions
+
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field(
+            "v", FieldOptions(field_type="int", min_=-300, max_=300)
+        )
+        ex = Executor(h)
+        rng = np.random.default_rng(31)
+        self.vals = {}
+        width = h.n_words * 32
+        for col in rng.choice(2 * width, size=150, replace=False):
+            v = int(rng.integers(-300, 300))
+            self.vals[int(col)] = v
+            ex.execute("i", f"Set({int(col)}, v={v})")
+        return h, ex
+
+    def test_repeat_range_counts_served(self, ex2):
+        _, ex = ex2
+        for op, want in [
+            ("Count(Row(v < 50))", sum(1 for v in self.vals.values() if v < 50)),
+            ("Count(Row(v >= -10))", sum(1 for v in self.vals.values() if v >= -10)),
+            ("Count(Row(v == 7))", sum(1 for v in self.vals.values() if v == 7)),
+        ]:
+            assert ex.execute("i", op)[0] == want
+        launches = ex.bsi_stack_launches
+        hits = ex.bsi_agg_cache_hits
+        for op, want in [
+            ("Count(Row(v < 50))", sum(1 for v in self.vals.values() if v < 50)),
+            ("Count(Row(v >= -10))", sum(1 for v in self.vals.values() if v >= -10)),
+            ("Count(Row(v == 7))", sum(1 for v in self.vals.values() if v == 7)),
+        ]:
+            for _ in range(2):
+                assert ex.execute("i", op)[0] == want
+        assert ex.bsi_stack_launches == launches
+        assert ex.bsi_agg_cache_hits >= hits + 6
+
+    def test_distinct_bounds_cached_separately(self, ex2):
+        _, ex = ex2
+        for n in (-100, 0, 100):
+            want = sum(1 for v in self.vals.values() if v < n)
+            assert ex.execute("i", f"Count(Row(v < {n}))")[0] == want
+        launches = ex.bsi_stack_launches
+        for n in (-100, 0, 100):
+            want = sum(1 for v in self.vals.values() if v < n)
+            assert ex.execute("i", f"Count(Row(v < {n}))")[0] == want
+        assert ex.bsi_stack_launches == launches
+
+    def test_write_invalidates_range_count(self, ex2):
+        _, ex = ex2
+        q = "Count(Row(v < 1000))"  # everything
+        before = ex.execute("i", q)[0]
+        ex.execute("i", q)  # cached
+        free = next(c for c in range(10_000) if c not in self.vals)
+        ex.execute("i", f"Set({free}, v=1)")
+        assert ex.execute("i", q)[0] == before + 1
+
+    def test_bitmap_result_not_affected(self, ex2):
+        """Only the COUNT is cached — Row(v < N) as a bitmap result must
+        still return the exact columns."""
+        _, ex = ex2
+        ex.execute("i", "Count(Row(v < 50))")
+        ex.execute("i", "Count(Row(v < 50))")  # count cached
+        cols = set(ex.execute("i", "Row(v < 50)")[0].columns().tolist())
+        assert cols == {c for c, v in self.vals.items() if v < 50}
