@@ -60,11 +60,23 @@ class DistributedExecutor:
     # thread count small (pool threads only block on remote HTTP I/O).
     _FANOUT_WORKERS = 8
 
-    def __init__(self, holder, cluster: Cluster, client, translator=None):
+    def __init__(
+        self, holder, cluster: Cluster, client, translator=None,
+        local_executor: Executor | None = None,
+    ):
         self.holder = holder
         self.cluster = cluster
         self.client = client
-        self.local = Executor(holder, translator=translator)
+        # share the API's executor when given: serving caches are
+        # field-level either way, but the per-executor counters
+        # (/debug/vars serving_cache) must reflect the queries actually
+        # executed.  translator only applies when WE build the executor —
+        # a supplied one keeps its own.
+        if local_executor is not None and translator is not None:
+            assert local_executor.translator is translator, (
+                "local_executor was built with a different translator"
+            )
+        self.local = local_executor or Executor(holder, translator=translator)
         # Lazily created: single-node paths never pay for pool threads.
         # Request threads (ThreadingHTTPServer) race on init and against
         # close(), so both go through _pool_lock and a closed flag.

@@ -86,7 +86,8 @@ class API:
             from pilosa_tpu.cluster.dist import DistributedExecutor
 
             self.dist = DistributedExecutor(
-                self.holder, cluster, client, translator=translator
+                self.holder, cluster, client, translator=translator,
+                local_executor=self.executor,
             )
         self._lock = threading.RLock()
         self._state = STATE_NORMAL

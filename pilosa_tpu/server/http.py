@@ -191,9 +191,22 @@ class Handler(BaseHTTPRequestHandler):
         )
 
     def r_debug_vars(self):
-        """expvar-style dump (reference http/handler.go:281)."""
+        """expvar-style dump (reference http/handler.go:281), including
+        the executor's serving-cache counters (the analogue of the
+        reference's cache stats, cache.go/stats)."""
         stats = self.api.holder.stats
-        snap = stats.snapshot() if hasattr(stats, "snapshot") else {}
+        snap = dict(stats.snapshot()) if hasattr(stats, "snapshot") else {}
+        ex = getattr(self.api, "executor", None)
+        if ex is not None:
+            snap["serving_cache"] = {
+                "gram_hits": ex.gram_cache_hits,
+                "rowcount_hits": ex.rowcount_cache_hits,
+                "crossgram_hits": ex.crossgram_cache_hits,
+                "bsi_agg_hits": ex.bsi_agg_cache_hits,
+                "stack_rebuilds": ex.stack_rebuilds,
+                "stack_incremental": ex.stack_incremental,
+                "bsi_stack_launches": ex.bsi_stack_launches,
+            }
         self._send_json(200, snap)
 
     def r_debug_threads(self):

@@ -145,5 +145,17 @@ def test_http_metrics_and_debug_vars(tmp_path):
         with urllib.request.urlopen(base + "/debug/vars") as r:
             snap = json.loads(r.read())
         assert any(k.startswith("set_bit") for k in snap["counters"])
+        # serving-cache counters ride along (the reference's cache
+        # stats analogue) and move when repeat queries hit the caches
+        assert snap["serving_cache"]["gram_hits"] == 0
+        q = b"Count(Intersect(Row(f=1), Row(f=1)))"
+        for _ in range(12):
+            req = urllib.request.Request(
+                base + "/index/i/query", data=q, method="POST"
+            )
+            urllib.request.urlopen(req).read()
+        with urllib.request.urlopen(base + "/debug/vars") as r:
+            snap = json.loads(r.read())
+        assert snap["serving_cache"]["gram_hits"] >= 1
     finally:
         node.stop()
