@@ -31,6 +31,7 @@ reference amortizes fsyncs.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import weakref
 from contextlib import contextmanager
@@ -95,6 +96,8 @@ def _scatter_rows(device_bits, slots, rows):
 class Fragment:
     """Dense bitmap tensor for one (index, field, view, shard)."""
 
+    _epoch_counter = itertools.count()
+
     def __init__(
         self,
         index: str = "",
@@ -120,6 +123,12 @@ class Fragment:
         # Monotonic mutation counter: cheap cache key for stacked-tensor
         # caches built over this fragment (executor batch fast path).
         self.version = 0
+        # Process-unique object nonce: a DIFFERENT Fragment later serving
+        # the same shard (dropped by resize cleanup, re-created when the
+        # shard moves back) must never alias a cached stack's version —
+        # both fragments count versions from 0, so the number alone can
+        # coincide. Cache keys pair (epoch, version).
+        self.epoch = next(self._epoch_counter)
         # op accounting for the storage layer's snapshot trigger
         # (reference fragment.go:84 MaxOpN, 2284-2293).
         self.op_n = 0

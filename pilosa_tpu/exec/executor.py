@@ -251,7 +251,11 @@ class Executor:
             mesh=mesh,
         )
         versions = tuple(
-            frags[s].version if s in frags else -1 for s in shards
+            # (epoch, version): a re-created fragment (resize drop +
+            # re-own) restarts version at 0, so the number alone could
+            # alias a cached stack; the epoch pins the object identity
+            (frags[s].epoch, frags[s].version) if s in frags else (-1, -1)
+            for s in shards
         )
         budget = membudget.default_budget()
         # Per-FIELD lock (fields are shared between executors wrapping the

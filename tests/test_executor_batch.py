@@ -524,3 +524,36 @@ class TestGroupByCrossGramServing:
         gc.collect()
         assert ref() is None  # nothing pins the retired device stack
         assert ex.execute("i", q)[0] == want  # recomputes, still right
+
+
+def test_recreated_fragment_never_aliases_cached_stack(setup):
+    """A shard's fragment dropped (resize cleanup) and re-created
+    restarts version at 0; if its mutation count coincides with the
+    cached stack's recorded number, the stack must STILL rebuild — the
+    epoch pins object identity (regression: versions compared by number
+    alone could serve stale bits)."""
+    h, ex = setup
+    q = _pairs_query([(0, 1)])
+    before = ex.execute("i", q + " " + _pairs_query([(2, 3)]))[0]
+    f = h.index("i").field("f")
+    view = f.view("standard")
+    old = view.fragments[0]
+    v_old = old.version
+    rows_snapshot = old.to_host_rows()
+    # replace with a NEW object: same bits plus one extra shared column,
+    # then pad its version to EXACTLY the old recorded number with
+    # cancelling scratch writes
+    view.drop_fragment(0)
+    frag = view.create_fragment_if_not_exists(0)
+    frag.load_host_rows(rows_snapshot)  # version -> 1
+    frag.set_bit(0, 999)
+    frag.set_bit(1, 999)  # both rows share col 999 now: count + 1
+    while frag.version < v_old - 1:
+        frag.set_bit(63, 5)
+        frag.clear_bit(63, 5)
+    frag.set_bit(63, 7)  # land exactly on v_old (harmless row)
+    while frag.version < v_old:
+        frag.set_bit(63, 8)
+    assert frag.version >= v_old
+    after = ex.execute("i", q)[0]
+    assert after == before + 1  # rebuilt from the NEW object's bits
