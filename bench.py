@@ -187,9 +187,12 @@ def main() -> None:
     # overlaps the compute of batch r+1), then each batch's [R, R] gram
     # is pulled and the per-query formula lookups run on the host —
     # both included in the measured time.  The salt XOR that varies the
-    # data across reps is fused INSIDE the jitted program so queued
-    # launches hold no extra index-sized copies in HBM.
-    gram_salted = jax.jit(lambda b, s: kernels.gram_matrix_xla(b ^ s))
+    # data across reps lives INSIDE the jitted program: on the fused
+    # Pallas gram path the XOR'd copy is a program-local intermediate
+    # (one index-sized transient per EXECUTING launch, freed on
+    # completion — queued launches hold none), and on the XLA fallback
+    # it fuses into the scan outright.
+    gram_salted = jax.jit(lambda b, s: kernels.gram_matrix_traced(b ^ s))
     salts = [jnp.uint32(i) for i in range(9)]
     _sync(gram_salted(bits, salts[-1]))  # compile
     reps = 4

@@ -313,3 +313,81 @@ def test_pallas_row_block_vmem_bounds():
     got = np.asarray(kernels.row_counts_per_shard_pallas(jnp.asarray(bits)))
     want = np.bitwise_count(bits).sum(axis=2)
     assert got.tolist() == want.tolist()
+
+
+class TestFusedGramPallas:
+    """The fused unpack+matmul Pallas gram must be bit-identical to the
+    XLA scan (it replaces it by default on TPU; interpret mode covers
+    the kernel body on CPU)."""
+
+    def test_pallas_gram_matches_xla(self):
+        from pilosa_tpu.ops import kernels
+        import jax.numpy as jnp
+        import jax
+
+        rng = np.random.default_rng(13)
+        S, R, W = 9, 16, 256  # S not divisible by SB: exercises padding
+        bits = jnp.asarray(
+            rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        want = np.asarray(kernels.gram_matrix_xla(bits))
+        got = np.asarray(
+            kernels._gram_matrix_pallas(
+                bits, sb=kernels._GRAM_PALLAS_SB, wb=128
+            )
+        )
+        assert np.array_equal(got, want)
+
+    def test_dispatcher_falls_back_off_tpu(self):
+        from pilosa_tpu.ops import kernels
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(13)
+        bits = jnp.asarray(
+            rng.integers(0, 2**32, size=(4, 8, 128), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        want = np.asarray(kernels.gram_matrix_xla(bits))
+        assert np.array_equal(np.asarray(kernels.gram_matrix(bits)), want)
+        assert np.array_equal(
+            np.asarray(kernels.gram_matrix_traced(bits)), want
+        )
+        idx = jnp.asarray(np.array([1, 3, 4, 1], np.int32))
+        assert np.array_equal(
+            np.asarray(kernels.gram_gather(bits, idx)),
+            np.asarray(kernels.gram_gather_xla(bits, idx)),
+        )
+
+    def test_wb_survives_non_power_of_two_rows(self):
+        """Regression: a non-power-of-two row count collapsed the word
+        block to 1-2 and silently disabled the fused kernel."""
+        from pilosa_tpu.ops import kernels
+
+        for R in (48, 96, 160, 1000):
+            assert kernels._gram_pallas_wb(R, 32768) >= 128, R
+        # and the block actually respects the VMEM budget
+        for R in (8, 48, 1024):
+            wb = kernels._gram_pallas_wb(R, 32768)
+            assert R * wb * 32 <= kernels._GRAM_PALLAS_UNPACK_BYTES
+
+    def test_pallas_gram_non_power_of_two_rows_matches(self):
+        from pilosa_tpu.ops import kernels
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        S, R, W = 3, 12, 256
+        bits = jnp.asarray(
+            rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        want = np.asarray(kernels.gram_matrix_xla(bits))
+        got = np.asarray(
+            kernels._gram_matrix_pallas(
+                bits, sb=kernels._GRAM_PALLAS_SB, wb=128
+            )
+        )
+        assert np.array_equal(got, want)

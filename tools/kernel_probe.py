@@ -46,7 +46,11 @@ def main() -> None:
     print(f"{jax.devices()[0]}: index {n_bits/1e9:.1f}e9 bits, B={B}")
 
     t = pipelined(lambda s: kernels.gram_matrix_xla(bits ^ s), [(s,) for s in salts])
-    print(f"gram (all {R*R} pairs): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
+    print(f"xla gram (all {R*R} pairs): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
+
+    fused = jax.jit(lambda b, s: kernels.gram_matrix_traced(b ^ s))
+    t = pipelined(lambda s: fused(bits, s), [(s,) for s in salts])
+    print(f"fused gram (pallas): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
 
     t = pipelined(
         lambda s: kernels.pair_count_batched_xla(bits ^ s, ras, rbs),
