@@ -337,6 +337,20 @@ _GRAM_PALLAS_SB = 8
 _GRAM_PALLAS_UNPACK_BYTES = 4 << 20
 
 
+def _bit_slabs(blk):
+    """[R, wb] uint32 -> [R, wb*32] int8 0/1 inside a Pallas kernel:
+    32 shift/mask slabs concatenated along the lane axis.  The self- and
+    cross-gram kernels MUST share this (their column permutations have
+    to agree with each other and be self-consistent for the gram)."""
+    return jnp.concatenate(
+        [
+            ((blk >> jnp.uint32(k)) & jnp.uint32(1)).astype(jnp.int8)
+            for k in range(32)
+        ],
+        axis=1,
+    )
+
+
 def _gram_pallas_kernel(in_ref, out_ref):
     """One [SB, R, WB] step of the self-gram: unpack each shard's word
     block to int8 bit slabs IN VMEM and feed the MXU.  The XLA scan
@@ -353,14 +367,7 @@ def _gram_pallas_kernel(in_ref, out_ref):
 
     acc = jnp.zeros(out_ref.shape, jnp.int32)
     for si in range(in_ref.shape[0]):
-        blk = in_ref[si]  # [R, WB] uint32
-        x = jnp.concatenate(
-            [
-                ((blk >> jnp.uint32(k)) & jnp.uint32(1)).astype(jnp.int8)
-                for k in range(32)
-            ],
-            axis=1,
-        )  # [R, WB*32] 0/1
+        x = _bit_slabs(in_ref[si])  # [R, WB*32] 0/1
         acc = acc + lax.dot_general(
             x, x, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32,
@@ -368,15 +375,24 @@ def _gram_pallas_kernel(in_ref, out_ref):
     out_ref[...] += acc
 
 
+def _gram_pallas_sb(S: int) -> int:
+    """Shards per grid step: the largest divisor of S up to
+    _GRAM_PALLAS_SB — a non-dividing block would force a full
+    index-sized jnp.pad copy per launch (measured: sb in 1..8 performs
+    identically; the scan is unpack-bound)."""
+    for sb in range(min(_GRAM_PALLAS_SB, S), 0, -1):
+        if S % sb == 0:
+            return sb
+    return 1
+
+
 @partial(jax.jit, static_argnames=("sb", "wb"))
 def _gram_matrix_pallas(bits: jax.Array, *, sb: int, wb: int) -> jax.Array:
     S, R, W = bits.shape
-    pad = (-S) % sb
-    if pad:
-        bits = jnp.pad(bits, ((0, pad), (0, 0), (0, 0)))  # zero rows add 0
-    return pl.pallas_call(
+    assert S % sb == 0, (S, sb)  # use _gram_pallas_sb; a non-dividing
+    return pl.pallas_call(       # block would silently drop shards
         _gram_pallas_kernel,
-        grid=((S + pad) // sb, W // wb),
+        grid=(S // sb, W // wb),
         in_specs=[pl.BlockSpec((sb, R, wb), lambda s, w: (s, 0, w))],
         out_specs=pl.BlockSpec((R, R), lambda s, w: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((R, R), jnp.int32),
@@ -384,10 +400,36 @@ def _gram_matrix_pallas(bits: jax.Array, *, sb: int, wb: int) -> jax.Array:
     )(bits)
 
 
-# The fused gram gets its OWN gate, default ON on TPU: unlike the scan
-# kernels (where fused XLA wins), it measures ~1.8x faster than the XLA
-# gram.  PILOSA_TPU_NO_PALLAS_GRAM=1 reverts to the XLA scan.
-_gram_pallas_ok: bool | None = None
+# The fused grams get their OWN gates, default ON on TPU: unlike the
+# scan kernels (where fused XLA wins), they measure ~1.7-1.8x faster
+# than the XLA grams.  PILOSA_TPU_NO_PALLAS_GRAM=1 reverts to XLA.
+# One gate PER KERNEL: the self- and cross-gram are distinct Mosaic
+# programs, so one kernel's probe result must neither vouch for nor
+# condemn the other.
+
+
+class _PallasGate:
+    """Tri-state probe flag for one Pallas kernel family: None =
+    unproven, True = proven good, False = demoted.  Past the probe,
+    demotion requires MAX_FAILS LIFETIME failures — one transient
+    (device OOM under load) must not disable a proven kernel, while a
+    persistently broken cached program must not be re-attempted
+    forever; the counter is deliberately never reset on success, so a
+    healthy sibling program sharing the gate cannot starve a broken
+    one's demotion."""
+
+    __slots__ = ("ok", "fails")
+    MAX_FAILS = 3
+
+    def __init__(self):
+        self.ok: bool | None = None
+        self.fails = 0  # lifetime count — NOT reset on success: a gate
+        # may serve several compiled programs, and a healthy one's
+        # successes must not starve a broken sibling's demotion
+
+
+_self_gram_gate = _PallasGate()
+_cross_gram_gate = _PallasGate()
 
 
 def _gram_pallas_wb(R: int, W: int) -> int:
@@ -403,9 +445,10 @@ def _gram_pallas_wb(R: int, W: int) -> int:
     return wb if wb >= 128 else 0  # lane-width floor: tiny blocks don't tile
 
 
-def _gram_pallas_eligible(R: int, W: int) -> bool:
+def _gram_pallas_eligible(R: int, W: int, gate=None) -> bool:
+    gate = gate or _self_gram_gate
     return (
-        _gram_pallas_ok is not False
+        gate.ok is not False
         and jax.default_backend() == "tpu"
         and os.environ.get("PILOSA_TPU_NO_PALLAS_GRAM") != "1"
         and _gram_pallas_wb(R, W) > 0
@@ -421,30 +464,57 @@ def gram_matrix_traced(bits: jax.Array) -> jax.Array:
     _, R, W = bits.shape
     if _gram_pallas_eligible(R, W):
         return _gram_matrix_pallas(
-            bits, sb=_GRAM_PALLAS_SB, wb=_gram_pallas_wb(R, W)
+            bits, sb=_gram_pallas_sb(bits.shape[0]), wb=_gram_pallas_wb(R, W)
         )
     return gram_matrix_xla(bits)
+
+
+def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
+    """The gram family's shared probe/demote contract: the first success
+    proves the gate; a failure BEFORE the gate is proven demotes it
+    permanently; past the probe, each failure is answered by
+    ``fallback_fn`` and counted visibly, and _PallasGate.MAX_FAILS
+    consecutive failures demote — balancing "one transient must not
+    disable a proven kernel" against "a persistently broken cached
+    program must not pay a failed launch per call forever"."""
+    gate = gate or _self_gram_gate
+    try:
+        # always synchronize INSIDE the try: async dispatch would let a
+        # runtime failure (e.g. device OOM) surface at the caller's
+        # np.asarray instead of being re-answered by the fallback — and
+        # every call site pulls the result immediately anyway
+        out = jax.block_until_ready(pallas_fn())
+        if gate.ok is None:
+            gate.ok = True
+        return out
+    except Exception as exc:
+        if gate.ok is None:
+            gate.ok = False
+            # a failed PROBE silently disables a default-ON fast path:
+            # log it once so the resulting latency is diagnosable
+            import logging
+
+            logging.getLogger("pilosa_tpu.kernels").warning(
+                "pallas gram probe failed; kernel family disabled: %r",
+                exc,
+            )
+        else:
+            _note_pallas_fallback(exc)
+            gate.fails += 1
+            if gate.fails >= gate.MAX_FAILS:
+                gate.ok = False
+        return fallback_fn()
 
 
 def gram_matrix(bits: jax.Array) -> jax.Array:
     """Self-gram dispatcher: fused-unpack Pallas kernel on TPU, XLA scan
     otherwise or on any Pallas failure."""
-    global _gram_pallas_ok
     _, R, W = bits.shape
     if _multi_device(bits) or not _gram_pallas_eligible(R, W):
         return gram_matrix_xla(bits)
-    try:
-        out = gram_matrix_traced(bits)
-        if _gram_pallas_ok is None:
-            jax.block_until_ready(out)
-            _gram_pallas_ok = True
-        return out
-    except Exception as exc:
-        if _gram_pallas_ok is None:
-            _gram_pallas_ok = False
-        else:
-            _note_pallas_fallback(exc)
-        return gram_matrix_xla(bits)
+    return _with_gram_fallback(
+        lambda: gram_matrix_traced(bits), lambda: gram_matrix_xla(bits)
+    )
 
 
 @jax.jit
@@ -484,14 +554,25 @@ def gram_gather_xla(bits: jax.Array, idx: jax.Array) -> jax.Array:
     return gram_matrix_xla(bits[:, idx])
 
 
+@jax.jit
+def _gram_gather_fused(bits: jax.Array, idx: jax.Array) -> jax.Array:
+    # gather fused into the same program as the kernel (mirrors
+    # _cross_gram_gather_fused: the eager form would materialize the
+    # gathered copy as a standalone dispatch)
+    return gram_matrix_traced(bits[:, idx])
+
+
 def gram_gather(bits: jax.Array, idx: jax.Array) -> jax.Array:
-    """Subset-gram dispatcher: gather then the fused Pallas gram when
-    eligible (the gather materializes [S, U, W] once, far cheaper than
-    the XLA scan's per-block int8 expansion), else the fused XLA scan."""
+    """Subset-gram dispatcher: gather+fused Pallas gram in one program
+    when eligible (the in-program gather is far cheaper than the XLA
+    scan's per-block int8 expansion), else the fused XLA scan."""
     U = int(idx.shape[0])
     _, _, W = bits.shape
     if not _multi_device(bits) and _gram_pallas_eligible(U, W):
-        return gram_matrix(bits[:, idx])
+        return _with_gram_fallback(
+            lambda: _gram_gather_fused(bits, idx),
+            lambda: gram_gather_xla(bits, idx),
+        )
     return gram_gather_xla(bits, idx)
 
 
@@ -725,31 +806,22 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
             # a device-local partial could wrap int32; callers fall back
             # to the scan kernels' [B, S] per-shard partials
             return None
-        global _gram_pallas_ok
         # eligibility must consider the shape the per-device base will
         # actually see (the padded gather subset, not the stack's R) —
         # a True-variant program that would trace to pure XLA anyway
         # must not own the Pallas gate's failure semantics
         use_p = _gram_pallas_eligible(R if full else len(idx), W)
-        fn = _gram_mesh_fn(mesh, axis, not full, False, use_p)
-        try:
-            out = fn(bits) if full else fn(bits, jnp.asarray(idx))
-            if use_p and _gram_pallas_ok is None:
-                jax.block_until_ready(out)
-                _gram_pallas_ok = True
-        except Exception as exc:
-            if not use_p:
-                raise
-            # per-device Pallas failed under shard_map: demote the gram
-            # gate (the cached True-variant program stays broken) and
-            # re-answer with the XLA base
-            if _gram_pallas_ok is None:
-                _gram_pallas_ok = False
-            else:
-                _note_pallas_fallback(exc)
-                _gram_pallas_ok = False
-            fn = _gram_mesh_fn(mesh, axis, not full, False, False)
-            out = fn(bits) if full else fn(bits, jnp.asarray(idx))
+
+        def _run(with_pallas: bool):
+            fn = _gram_mesh_fn(mesh, axis, not full, False, with_pallas)
+            return fn(bits) if full else fn(bits, jnp.asarray(idx))
+
+        if use_p:
+            out = _with_gram_fallback(
+                lambda: _run(True), lambda: _run(False)
+            )
+        else:
+            out = _run(False)
         return np.asarray(out).astype(np.int64).sum(axis=0)[:U, :U]
     if _gram_int32_safe(S, W):
         if full:
@@ -827,6 +899,104 @@ def cross_gram_gather_xla(
     return cross_gram_xla(bits_a[:, ia], bits_b[:, ib])
 
 
+def _cross_gram_pallas_kernel(a_ref, b_ref, out_ref):
+    """Fused-unpack cross gram — both operands' word blocks unpack to
+    int8 bit slabs in VMEM (same bottleneck analysis as
+    _gram_pallas_kernel; the cross variant pays the VPU unpack twice)."""
+    s = pl.program_id(0)
+    w = pl.program_id(1)
+
+    @pl.when((s == 0) & (w == 0))
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    acc = jnp.zeros(out_ref.shape, jnp.int32)
+    for si in range(a_ref.shape[0]):
+        acc = acc + lax.dot_general(
+            _bit_slabs(a_ref[si]),
+            _bit_slabs(b_ref[si]),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+    out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("sb", "wb"))
+def _cross_gram_pallas(
+    bits_a: jax.Array, bits_b: jax.Array, *, sb: int, wb: int
+) -> jax.Array:
+    S, Ra, W = bits_a.shape
+    Rb = bits_b.shape[1]
+    assert S % sb == 0, (S, sb)  # see _gram_matrix_pallas
+    return pl.pallas_call(
+        _cross_gram_pallas_kernel,
+        grid=(S // sb, W // wb),
+        in_specs=[
+            pl.BlockSpec((sb, Ra, wb), lambda s, w: (s, 0, w)),
+            pl.BlockSpec((sb, Rb, wb), lambda s, w: (s, 0, w)),
+        ],
+        out_specs=pl.BlockSpec((Ra, Rb), lambda s, w: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Ra, Rb), jnp.int32),
+        interpret=_interpret(),
+    )(bits_a, bits_b)
+
+
+def _cross_pallas_engages(Ra: int, Rb: int, W: int) -> bool:
+    """The ONE cross-gram Pallas predicate — cross_gram_traced and every
+    call site that wraps it in _with_gram_fallback must share it, or a
+    desynced gate would let a quietly-XLA trace falsely prove the
+    Pallas gate.  Both operands' unpacked slabs share the VMEM budget,
+    so eligibility uses Ra + Rb."""
+    return (
+        Ra >= 8
+        and Rb >= 8
+        and _gram_pallas_eligible(Ra + Rb, W, gate=_cross_gram_gate)
+    )
+
+
+def cross_gram_traced(bits_a: jax.Array, bits_b: jax.Array) -> jax.Array:
+    """Trace-safe cross-gram chooser (see gram_matrix_traced)."""
+    _, Ra, W = bits_a.shape
+    Rb = bits_b.shape[1]
+    if _cross_pallas_engages(Ra, Rb, W):
+        return _cross_gram_pallas(
+            bits_a,
+            bits_b,
+            sb=_gram_pallas_sb(bits_a.shape[0]),
+            wb=_gram_pallas_wb(Ra + Rb, W),
+        )
+    return cross_gram_xla(bits_a, bits_b)
+
+
+@jax.jit
+def _cross_gram_gather_fused(
+    bits_a: jax.Array, bits_b: jax.Array, ia: jax.Array, ib: jax.Array
+) -> jax.Array:
+    # gather fused into the same program as the kernel (the eager form
+    # would materialize the gathered copies as standalone dispatches)
+    return cross_gram_traced(bits_a[:, ia], bits_b[:, ib])
+
+
+def cross_gram_gather(
+    bits_a: jax.Array, bits_b: jax.Array, ia: jax.Array, ib: jax.Array
+) -> jax.Array:
+    """Subset cross-gram dispatcher with the gram family's runtime
+    fallback semantics."""
+    _, _, W = bits_a.shape
+    Ua, Ub = int(ia.shape[0]), int(ib.shape[0])
+    if (
+        _multi_device(bits_a)
+        or _multi_device(bits_b)
+        or not _cross_pallas_engages(Ua, Ub, W)
+    ):
+        return cross_gram_gather_xla(bits_a, bits_b, ia, ib)
+    return _with_gram_fallback(
+        lambda: _cross_gram_gather_fused(bits_a, bits_b, ia, ib),
+        lambda: cross_gram_gather_xla(bits_a, bits_b, ia, ib),
+        gate=_cross_gram_gate,
+    )
+
+
 @lru_cache(maxsize=64)
 def _cross_gram_mesh_fn(mesh, axis, in_program_reduce):
     """Cross gram over aligned shards-sharded stacks — stacked partials
@@ -901,12 +1071,12 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
         return None  # mismatched shardings; scan kernels handle it
     ia_d, ib_d = jnp.asarray(ia), jnp.asarray(ib)
     if _gram_int32_safe(S, W):
-        out = cross_gram_gather_xla(bits_a, bits_b, ia_d, ib_d)
+        out = cross_gram_gather(bits_a, bits_b, ia_d, ib_d)
         return np.asarray(out).astype(np.int64)[:Ua, :Ub]
     chunk = max(1, _GRAM_ACC_LIMIT // (W * 32))
     total = np.zeros((len(ia), len(ib)), np.int64)
     for c0 in range(0, S, chunk):
-        out = cross_gram_gather_xla(
+        out = cross_gram_gather(
             bits_a[c0 : c0 + chunk], bits_b[c0 : c0 + chunk], ia_d, ib_d
         )
         total += np.asarray(out).astype(np.int64)
@@ -1079,6 +1249,13 @@ def _combo_gram_xla(prefix: jax.Array, bits: jax.Array, idx: jax.Array):
     return cross_gram_xla(jnp.transpose(prefix, (1, 0, 2)), bits[:, idx])
 
 
+@jax.jit
+def _combo_gram_fused(prefix: jax.Array, bits: jax.Array, idx: jax.Array):
+    # trace-time chooser: Pallas when the gate/shape allow (the caller
+    # guards with _gram_pallas_eligible and _with_gram_fallback)
+    return cross_gram_traced(jnp.transpose(prefix, (1, 0, 2)), bits[:, idx])
+
+
 def combo_counts_gram(prefix: jax.Array, bits: jax.Array, idx) -> np.ndarray | None:
     """``int64 numpy [C, Rl]`` totals of every (prefix combo, row)
     intersection as ONE cross gram on the MXU — the k-level GroupBy's
@@ -1100,7 +1277,20 @@ def combo_counts_gram(prefix: jax.Array, bits: jax.Array, idx) -> np.ndarray | N
         # replicate prefix + stack onto every device; the scan kernels
         # iterate rows and partition cleanly, so decline
         return None
-    out = _combo_gram_xla(prefix, bits, jnp.asarray(idx, jnp.int32))
+    idx_dev = jnp.asarray(idx, jnp.int32)
+    # the shared predicate keeps this gate in lockstep with
+    # cross_gram_traced (a desync would falsely prove the Pallas gate
+    # from a quietly-XLA trace); a replicated multi-device stack (no
+    # shards axis, >1 device) must keep the XLA path, which partitions
+    # cleanly
+    if not _multi_device(bits) and _cross_pallas_engages(C, len(idx), W):
+        out = _with_gram_fallback(
+            lambda: _combo_gram_fused(prefix, bits, idx_dev),
+            lambda: _combo_gram_xla(prefix, bits, idx_dev),
+            gate=_cross_gram_gate,
+        )
+    else:
+        out = _combo_gram_xla(prefix, bits, idx_dev)
     return np.asarray(out).astype(np.int64)
 
 

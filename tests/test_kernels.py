@@ -326,7 +326,7 @@ class TestFusedGramPallas:
         import jax
 
         rng = np.random.default_rng(13)
-        S, R, W = 9, 16, 256  # S not divisible by SB: exercises padding
+        S, R, W = 9, 16, 256  # S=9 -> sb divisor 3 (no pad path exists)
         bits = jnp.asarray(
             rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint64).astype(
                 np.uint32
@@ -335,7 +335,7 @@ class TestFusedGramPallas:
         want = np.asarray(kernels.gram_matrix_xla(bits))
         got = np.asarray(
             kernels._gram_matrix_pallas(
-                bits, sb=kernels._GRAM_PALLAS_SB, wb=128
+                bits, sb=kernels._gram_pallas_sb(bits.shape[0]), wb=128
             )
         )
         assert np.array_equal(got, want)
@@ -387,7 +387,75 @@ class TestFusedGramPallas:
         want = np.asarray(kernels.gram_matrix_xla(bits))
         got = np.asarray(
             kernels._gram_matrix_pallas(
-                bits, sb=kernels._GRAM_PALLAS_SB, wb=128
+                bits, sb=kernels._gram_pallas_sb(bits.shape[0]), wb=128
             )
         )
         assert np.array_equal(got, want)
+
+    def test_pallas_cross_gram_matches_xla(self):
+        """The fused cross gram (2-level GroupBy path, default ON on
+        TPU) must be bit-identical to the XLA scan — asymmetric row
+        counts and a non-divisible shard axis included."""
+        from pilosa_tpu.ops import kernels
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(21)
+        S, Ra, Rb, W = 5, 12, 24, 256
+        a = jnp.asarray(
+            rng.integers(0, 2**32, size=(S, Ra, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        b = jnp.asarray(
+            rng.integers(0, 2**32, size=(S, Rb, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        want = np.asarray(kernels.cross_gram_xla(a, b))
+        got = np.asarray(
+            kernels._cross_gram_pallas(
+                a, b, sb=kernels._gram_pallas_sb(a.shape[0]), wb=128
+            )
+        )
+        assert np.array_equal(got, want)
+
+    def test_combo_gate_requires_both_sides_wide(self):
+        """combo_counts_gram must not route through the 'fused' variant
+        when either side is below cross_gram_traced's floor — a pure-XLA
+        trace would falsely prove the Pallas gate."""
+        from pilosa_tpu.ops import kernels
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        S, C, Rl, W = 2, 4, 16, 256  # C < 8: must take the plain path
+        prefix = jnp.asarray(
+            rng.integers(0, 2**32, size=(C, S, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        bits = jnp.asarray(
+            rng.integers(0, 2**32, size=(S, Rl, W), dtype=np.uint64).astype(
+                np.uint32
+            )
+        )
+        # force eligibility so the routing itself is what the test
+        # enforces (off-TPU the eligibility gate is always False and the
+        # guard would be vacuous)
+        from unittest import mock
+
+        with mock.patch.object(
+            kernels, "_gram_pallas_eligible", lambda *a: True
+        ), mock.patch.object(
+            kernels,
+            "_with_gram_fallback",
+            side_effect=AssertionError(
+                "C < 8 must not take the fused cross-gram path"
+            ),
+        ):
+            out = kernels.combo_counts_gram(prefix, bits, list(range(Rl)))
+        want = (
+            np.asarray(kernels.combo_counts(prefix, bits, jnp.arange(Rl)))
+            .astype(np.int64)
+            .sum(axis=2)
+        )
+        assert np.array_equal(out, want)

@@ -50,7 +50,8 @@ def main() -> None:
 
     fused = jax.jit(lambda b, s: kernels.gram_matrix_traced(b ^ s))
     t = pipelined(lambda s: fused(bits, s), [(s,) for s in salts])
-    print(f"fused gram (pallas): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
+    kind = "pallas" if kernels._gram_pallas_eligible(R, W) else "xla (pallas ineligible)"
+    print(f"fused gram ({kind}): {t*1e3:.1f} ms/launch -> {B/t:.0f} qps at B={B}")
 
     t = pipelined(
         lambda s: kernels.pair_count_batched_xla(bits ^ s, ras, rbs),
