@@ -12,9 +12,11 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
   ``G[i, j] = |row_i & row_j|`` on the systolic array.  Every pair op
   reduces to gram entries: ``|a|b| = G[aa]+G[bb]-G[ab]``,
   ``|a\\b| = G[aa]-G[ab]``, ``|a^b| = G[aa]+G[bb]-2G[ab]``.  Measured on
-  v5e (10.7e9-bit index, B=1024): 38 ms/launch for all 64x64 pairs vs
-  918 ms for the per-query gather+popcount scan — the MXU turns 2*B row
-  reads into one index read.
+  v5e (10.7e9-bit index, B=1024): 21.6 ms/launch for all 64x64 pairs
+  with the fused-unpack Pallas kernel (36 ms for the XLA scan, 918 ms
+  for the per-query gather+popcount scan) — the MXU turns 2*B row reads
+  into one index read, and the Pallas variant keeps the 32x int8
+  expansion in VMEM instead of HBM.
 * **Fused XLA scans** for per-row popcounts (TopN) and everything else:
   measured 154 GB/s vs 106 GB/s for the best hand-written Pallas
   streaming kernel on the same shape — XLA's fusion of
@@ -474,9 +476,11 @@ def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
     proves the gate; a failure BEFORE the gate is proven demotes it
     permanently; past the probe, each failure is answered by
     ``fallback_fn`` and counted visibly, and _PallasGate.MAX_FAILS
-    consecutive failures demote — balancing "one transient must not
-    disable a proven kernel" against "a persistently broken cached
-    program must not pay a failed launch per call forever"."""
+    LIFETIME failures demote (never reset on success — a healthy
+    sibling program sharing the gate must not starve a broken one's
+    demotion) — balancing "one transient must not disable a proven
+    kernel" against "a persistently broken cached program must not pay
+    a failed launch per call forever"."""
     gate = gate or _self_gram_gate
     try:
         # always synchronize INSIDE the try: async dispatch would let a

@@ -459,3 +459,50 @@ class TestFusedGramPallas:
             .sum(axis=2)
         )
         assert np.array_equal(out, want)
+
+
+class TestGramGatePolicy:
+    """_with_gram_fallback's probe/demote contract: a failed probe
+    demotes immediately (with a log); past the probe, transients
+    survive and MAX_FAILS lifetime failures demote."""
+
+    def _gate(self):
+        return kernels._PallasGate()
+
+    def test_probe_failure_demotes_and_answers(self):
+        gate = self._gate()
+
+        def boom():
+            raise RuntimeError("mosaic says no")
+
+        out = kernels._with_gram_fallback(boom, lambda: "xla", gate=gate)
+        assert out == "xla"
+        assert gate.ok is False
+
+    def test_established_gate_survives_transients_then_demotes(self):
+        gate = self._gate()
+        ok = lambda: jnp.zeros(())
+        assert kernels._with_gram_fallback(ok, lambda: "x", gate=gate) is not None
+        assert gate.ok is True
+
+        def boom():
+            raise RuntimeError("transient OOM")
+
+        for i in range(gate.MAX_FAILS - 1):
+            assert (
+                kernels._with_gram_fallback(boom, lambda: "x", gate=gate)
+                == "x"
+            )
+            assert gate.ok is True  # transients survive
+        assert kernels._with_gram_fallback(boom, lambda: "x", gate=gate) == "x"
+        assert gate.ok is False  # lifetime cap reached
+
+    def test_gates_are_independent(self):
+        g1, g2 = self._gate(), self._gate()
+
+        def boom():
+            raise RuntimeError("no")
+
+        kernels._with_gram_fallback(boom, lambda: "x", gate=g1)
+        assert g1.ok is False
+        assert g2.ok is None  # one kernel's probe never condemns another
