@@ -79,6 +79,7 @@ def _retry_evict(ref) -> None:
             f._evict_pending = False
             f._device = None
             f._dirty.clear()
+            f._delta_reset()
             # The flag may be stale: a concurrent device_bits can have
             # re-admitted the copy after the deferral was recorded.  The
             # accounting must follow the copy we just dropped, or the
@@ -91,6 +92,16 @@ def _retry_evict(ref) -> None:
 @jax.jit
 def _scatter_rows(device_bits, slots, rows):
     return device_bits.at[slots].set(rows)
+
+
+@jax.jit
+def _scatter_words(device_bits, flat_idx, vals):
+    """Word-granular device update: flat positions into the row-major
+    [capacity+1, W] copy.  Ships 8 bytes per CHANGED WORD instead of a
+    whole row per dirty slot — the winning path when a write batch
+    touches many rows sparsely (the common ingest shape)."""
+    shape = device_bits.shape
+    return device_bits.reshape(-1).at[flat_idx].set(vals).reshape(shape)
 
 
 class Fragment:
@@ -119,6 +130,17 @@ class Fragment:
         self._host = np.zeros((0, n_words), dtype=np.uint32)
         self._device: jax.Array | None = None
         self._dirty: set[int] = set()
+        # word-granular change tracking riding alongside _dirty: flat
+        # (slot * n_words + word) indices accumulated per mutation
+        # batch; None = degraded (an untracked mutation happened or the
+        # delta grew past worthwhile), meaning sync falls back to the
+        # row/full paths.  Always cleared together with _dirty.
+        # fields are established by _delta_reset below — ONE place owns
+        # the reset semantics (including the int32-eligibility degrade)
+        self._word_delta: list[np.ndarray] | None = None
+        self._word_delta_small: set[int] = set()
+        self._word_delta_n = 0
+        self._word_delta_compact_at = 0
         self._counts: np.ndarray | None = None  # per-slot cached popcounts
         # Monotonic mutation counter: cheap cache key for stacked-tensor
         # caches built over this fragment (executor batch fast path).
@@ -143,6 +165,7 @@ class Fragment:
         # set by the budget's evict callback when it could not take the
         # lock; honored at the next device sync
         self._evict_pending = False
+        self._delta_reset()
 
     # -- row bookkeeping ----------------------------------------------------
 
@@ -186,12 +209,19 @@ class Fragment:
         the lock); host mirror stays authoritative."""
         self._device = None
         self._dirty.clear()
+        self._delta_reset()
         if self._budget_key is not None:
             membudget.default_budget().release(self._budget_key)
 
     # -- mutation -----------------------------------------------------------
 
-    def _touch(self, slot: int) -> None:
+    def _touch(self, slot: int, tracked: bool = False) -> None:
+        """Mark a slot mutated.  ``tracked=True`` promises the caller
+        already recorded the exact changed words via _delta_note*; any
+        untracked mutation degrades word-granular sync (correct by
+        default for future mutation paths)."""
+        if not tracked:
+            self._delta_degrade()
         self._dirty.add(slot)
         self._counts = None
         self.version += 1
@@ -200,6 +230,100 @@ class Fragment:
             self.on_op(self)
         if PARANOIA:
             self.check_invariants()
+
+    # word-delta tracking degrades past this fraction of the fragment's
+    # words — a full re-upload is cheaper than a giant scatter
+    _WORD_DELTA_MAX_FRACTION = 8
+
+    def _delta_over_budget(self) -> bool:
+        """Whether the delta outgrew its budget.  Duplicate notes (the
+        same words mutated repeatedly) inflate the raw count, so compact
+        to unique positions before deciding to degrade — but only past
+        2x budget (hysteresis): compacting at the boundary would re-sort
+        the whole delta on every subsequent mutation."""
+        budget = (
+            max(1, self.capacity) * self.n_words
+            // self._WORD_DELTA_MAX_FRACTION
+        )
+        raw = self._word_delta_n + len(self._word_delta_small)
+        if raw <= budget:
+            return False
+        if self._word_delta_n == 0:
+            return True  # the set alone is already unique: genuinely over
+        if raw < self._word_delta_compact_at:
+            return False  # tolerate duplicates until raw doubles again —
+            # a delta parked at ~budget unique positions must not be
+            # re-sorted on every subsequent duplicate note
+        flat = self._delta_flat()
+        self._word_delta = [flat]
+        self._word_delta_small = set()
+        self._word_delta_n = len(flat)
+        self._word_delta_compact_at = 2 * max(len(flat), budget)
+        return len(flat) > budget
+
+    def _delta_note(self, flat: np.ndarray) -> None:
+        """Record changed flat word positions (slot * n_words + word)
+        for the word-granular device sync (caller holds the lock)."""
+        if self._word_delta is None:
+            return
+        if (self.capacity + 1) * self.n_words >= 2**31:
+            # the word path's int32 scatter can never serve this
+            # fragment; don't accumulate notes it can't use
+            self._delta_degrade()
+            return
+        self._word_delta.append(np.asarray(flat, dtype=np.int64))
+        self._word_delta_n += len(flat)
+        if self._delta_over_budget():
+            self._delta_degrade()
+
+    def _delta_note_word(self, slot: int, word: int) -> None:
+        """Single-word note: a plain set add (no per-bit ndarray churn),
+        naturally deduped so toggle-heavy workloads on few words don't
+        inflate the degrade counter."""
+        if self._word_delta is not None:
+            self._word_delta_small.add(slot * self.n_words + word)
+            if self._delta_over_budget():
+                self._delta_degrade()
+
+    def _delta_note_mask(self, slot: int, mask: np.ndarray) -> None:
+        """Record every set word of ``mask`` as changed for ``slot``."""
+        if self._word_delta is not None:
+            w = np.flatnonzero(mask)
+            self._delta_note(slot * self.n_words + w.astype(np.int64))
+
+    def _delta_degrade(self) -> None:
+        """An untracked or too-large mutation: word-granular sync is off
+        until the next device rebuild."""
+        self._word_delta = None
+        self._word_delta_small = set()
+        self._word_delta_n = 0
+
+    def _delta_reset(self) -> None:
+        if (self.capacity + 1) * self.n_words >= 2**31:
+            # the int32 word scatter can never serve this fragment:
+            # don't track notes it can't use (capacity only changes
+            # through paths that re-run this reset)
+            self._delta_degrade()
+            return
+        self._word_delta = []
+        self._word_delta_small = set()
+        self._word_delta_n = 0
+        self._word_delta_compact_at = 0
+
+    def _delta_flat(self) -> np.ndarray:
+        """All noted word positions, deduped (caller checked not-None)."""
+        parts = list(self._word_delta)
+        if self._word_delta_small:
+            parts.append(
+                np.fromiter(
+                    self._word_delta_small,
+                    dtype=np.int64,
+                    count=len(self._word_delta_small),
+                )
+            )
+        if not parts:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(parts))
 
     def check_invariants(self, device: bool = False) -> None:
         """Verify slot-map ↔ host-mirror ↔ device-copy coherence; raises
@@ -287,7 +411,8 @@ class Fragment:
             if self._host[s, w] & b:
                 return False
             self._host[s, w] |= b
-            self._touch(s)
+            self._delta_note_word(s, w)
+            self._touch(s, tracked=True)
             if self.store is not None:
                 self.store.log_add(row, col)
             return True
@@ -301,7 +426,8 @@ class Fragment:
             if not self._host[s, w] & b:
                 return False
             self._host[s, w] &= ~b
-            self._touch(s)
+            self._delta_note_word(s, w)
+            self._touch(s, tracked=True)
             if self.store is not None:
                 self.store.log_remove(row, col)
             return True
@@ -336,7 +462,8 @@ class Fragment:
                 return False
             old = self._host[s].copy()
             self._host[s] = words
-            self._touch(s)
+            self._delta_note_mask(s, old ^ words)
+            self._touch(s, tracked=True)
             # log AFTER applying: a snapshot triggered mid-logging then
             # serializes the new state, against which these ops replay
             # idempotently
@@ -365,7 +492,8 @@ class Fragment:
             added = bitops.popcount_host(added_mask)
             if added:
                 self._host[s] |= words
-                self._touch(s)
+                self._delta_note_mask(s, added_mask)
+                self._touch(s, tracked=True)
                 if self.store is not None:
                     self.store.log_add_mask(row, added_mask)
             return added
@@ -381,7 +509,8 @@ class Fragment:
             removed = bitops.popcount_host(removed_mask)
             if removed:
                 self._host[s] &= ~words
-                self._touch(s)
+                self._delta_note_mask(s, removed_mask)
+                self._touch(s, tracked=True)
                 if self.store is not None:
                     self.store.log_remove_mask(row, removed_mask)
             return removed
@@ -436,6 +565,13 @@ class Fragment:
             changed_idx = np.nonzero(per_row)[0]
             for i in changed_idx:
                 self._dirty.add(int(slots[i]))
+            if len(changed_idx) and self._word_delta is not None:
+                # exact changed words for the whole batch in one nonzero
+                ci, wi = np.nonzero(mask[changed_idx])
+                self._delta_note(
+                    slots[changed_idx][ci] * self.n_words
+                    + wi.astype(np.int64)
+                )
             if self.store is not None and len(changed_idx):
                 # one vectorized unpack for the whole batch's op records
                 if clear:
@@ -504,6 +640,7 @@ class Fragment:
                 try:
                     f._device = None
                     f._dirty.clear()
+                    f._delta_reset()
                     # A concurrent device_bits may have re-admitted the
                     # entry between the budget's pop and this callback;
                     # drop that accounting with the copy (no-op in the
@@ -541,36 +678,74 @@ class Fragment:
                 self._evict_pending = False
                 self._device = None
                 self._dirty.clear()
+                self._delta_reset()
             rebuilt = False
             if self._device is None or self._device.shape[0] != self.capacity + 1:
                 padded = np.zeros((self.capacity + 1, self.n_words), dtype=np.uint32)
                 padded[: self.capacity] = self._host
                 self._device = jnp.asarray(padded)
                 self._dirty.clear()
+                self._delta_reset()
                 rebuilt = True
             elif self._dirty:
-                if len(self._dirty) > max(8, self.capacity // 2):
-                    padded = np.zeros(
-                        (self.capacity + 1, self.n_words), dtype=np.uint32
+                # choose the cheapest transfer: changed words (8 B each),
+                # dirty rows (W*4 B each), or the full copy
+                flat = None
+                if self._word_delta is not None and (
+                    (self.capacity + 1) * self.n_words < 2**31
+                ):
+                    flat = self._delta_flat()
+                word_bytes = (
+                    bitops.pow2_pad_len(len(flat)) * 8 if flat is not None else None
+                )
+                # past half the rows dirty, a wholesale device_put beats
+                # the row scatter's host gather + jitted update, so the
+                # row path's effective cost becomes the full copy
+                prefer_full = len(self._dirty) > max(8, self.capacity // 2)
+                full_bytes = (self.capacity + 1) * self.n_words * 4
+                row_cost = (
+                    full_bytes
+                    if prefer_full
+                    else bitops.pow2_pad_len(len(self._dirty)) * self.n_words * 4
+                )
+                if (
+                    word_bytes is not None
+                    and word_bytes <= row_cost
+                    # empty delta with dirty slots would mean a tracked
+                    # mutation forgot its note — never trust it; the
+                    # row/full paths below handle it correctly
+                    and len(flat)
+                ):
+                    idx = np.full(
+                        bitops.pow2_pad_len(len(flat)), flat[0], np.int32
                     )
-                    padded[: self.capacity] = self._host
-                    self._device = jnp.asarray(padded)
-                else:
+                    idx[: len(flat)] = flat.astype(np.int32)
+                    vals = self._host.reshape(-1)[idx]
+                    self._device = _scatter_words(
+                        self._device, jnp.asarray(idx), jnp.asarray(vals)
+                    )
+                elif not prefer_full:
                     slots = np.fromiter(self._dirty, dtype=np.int32)
                     # Pad to a power-of-two bucket so the jitted scatter sees
                     # a bounded set of shapes (duplicate slot writes of the
                     # same data are harmless).
-                    n = 1
-                    while n < len(slots):
-                        n *= 2
-                    padded_slots = np.full(n, slots[0], dtype=np.int32)
+                    padded_slots = np.full(
+                        bitops.pow2_pad_len(len(slots)), slots[0], dtype=np.int32
+                    )
                     padded_slots[: len(slots)] = slots
                     self._device = _scatter_rows(
                         self._device,
                         jnp.asarray(padded_slots),
                         jnp.asarray(self._host[padded_slots]),
                     )
+                else:
+                    padded = np.zeros(
+                        (self.capacity + 1, self.n_words), dtype=np.uint32
+                    )
+                    padded[: self.capacity] = self._host
+                    self._device = jnp.asarray(padded)
                 self._dirty.clear()
+                self._delta_reset()
             self._account_device(rebuilt)
             return self._device
 
@@ -706,7 +881,8 @@ class Fragment:
             set_slots = np.flatnonzero(self._host[:n, w] & bmask)
             self._host[set_slots, w] &= ~bmask
             for s in set_slots.tolist():
-                self._touch(int(s))
+                self._delta_note_word(int(s), w)
+                self._touch(int(s), tracked=True)
                 if self.store is not None:
                     self.store.log_remove(self._rowids[s], col)
             return True

@@ -80,8 +80,8 @@ _WRITE_CALLS = {
 
 def _pow2(n: int) -> int:
     """Batch sizes pad to powers of two so jit programs are reused
-    across drifting batch sizes."""
-    return 1 << (n - 1).bit_length()
+    across drifting batch sizes (shared impl: ops/bitops)."""
+    return bitops.pow2_pad_len(n)
 
 
 def _is_write(call: Call) -> bool:

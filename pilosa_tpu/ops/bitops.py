@@ -29,6 +29,14 @@ from pilosa_tpu.shardwidth import SHARD_WORDS, WORD_BITS
 # ---------------------------------------------------------------------------
 
 
+def pow2_pad_len(n: int) -> int:
+    """Power-of-two bucket for padding batch/scatter shapes so jit
+    programs are reused across drifting sizes; 1 for n <= 1."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
 def pack_columns(cols: np.ndarray, n_words: int = SHARD_WORDS) -> np.ndarray:
     """Pack a sorted-or-not array of column offsets into uint32 words."""
     words = np.zeros(n_words, dtype=np.uint32)

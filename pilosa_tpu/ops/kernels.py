@@ -43,6 +43,8 @@ from jax.experimental import pallas as pl
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pilosa_tpu.ops.bitops import pow2_pad_len
+
 _OPS = {
     "intersect": lambda a, b: a & b,
     "union": lambda a, b: a | b,
@@ -785,7 +787,7 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     if not full:
         # pad the gather to a power of two (repeating row 0) so jit
         # programs are reused as the batch's distinct-row count drifts
-        Up = 1 << (U - 1).bit_length()
+        Up = pow2_pad_len(U)
         idx = np.zeros(Up, np.int32)
         idx[:U] = row_idx
     m = shards_axis_of(bits)
@@ -1044,9 +1046,9 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
     if Ua == 0 or Ub == 0 or max(Ua, Ub) > GRAM_MAX_ROWS:
         return None
     # pad gathers to powers of two for program reuse
-    ia = np.zeros(1 << (Ua - 1).bit_length(), np.int32)
+    ia = np.zeros(pow2_pad_len(Ua), np.int32)
     ia[:Ua] = idx_a
-    ib = np.zeros(1 << (Ub - 1).bit_length(), np.int32)
+    ib = np.zeros(pow2_pad_len(Ub), np.int32)
     ib[:Ub] = idx_b
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:

@@ -166,3 +166,83 @@ def test_import_values_duplicate_cols_last_wins():
     assert f.value(7, 4) == (1, True)
     f.import_values(np.array([7, 7]), np.array([1, -1]), 4)
     assert f.value(7, 4) == (-1, True)
+
+
+class TestWordDeltaSync:
+    """Word-granular device sync: after any mix of tracked mutations,
+    the device copy must equal the host mirror bit for bit, and batches
+    touching many rows sparsely must take the word path (not a full
+    re-upload)."""
+
+    def test_device_coherent_after_mixed_mutations(self):
+        import numpy as np
+        from pilosa_tpu.core.fragment import Fragment
+
+        rng = np.random.default_rng(3)
+        f = Fragment(n_words=64)
+        f.import_bits(
+            rng.integers(0, 40, size=500).astype(np.uint64),
+            rng.integers(0, 64 * 32, size=500),
+        )
+        f.device_bits()
+        # sparse mutations across many rows -> word path
+        f.import_bits(
+            rng.integers(0, 40, size=60).astype(np.uint64),
+            rng.integers(0, 64 * 32, size=60),
+        )
+        f.set_bit(7, 100)
+        f.clear_bit(7, 100)
+        f.set_bit(41, 3)  # new row -> capacity may grow (rebuild path)
+        f.union_row_words(2, np.full(64, 0x0F0F0F0F, np.uint32))
+        f.difference_row_words(2, np.full(64, 0x00FF00FF, np.uint32))
+        f.set_row_words(3, rng.integers(0, 2**32, size=64, dtype=np.uint64).astype(np.uint32))
+        f.device_bits()
+        f.check_invariants(device=True)  # device == host, every row
+
+    def test_sparse_batch_takes_word_path(self, monkeypatch):
+        import numpy as np
+        from pilosa_tpu.core import fragment as fragmod
+        from pilosa_tpu.core.fragment import Fragment
+
+        rng = np.random.default_rng(5)
+        f = Fragment(n_words=256)
+        # 32 rows so the fragment is big enough that rows >> words changed
+        f.import_bits(
+            np.arange(32, dtype=np.uint64).repeat(4),
+            rng.integers(0, 256 * 32, size=128),
+        )
+        f.device_bits()
+        calls = {"words": 0, "rows": 0}
+        real_w, real_r = fragmod._scatter_words, fragmod._scatter_rows
+
+        def spy_w(*a):
+            calls["words"] += 1
+            return real_w(*a)
+
+        def spy_r(*a):
+            calls["rows"] += 1
+            return real_r(*a)
+
+        monkeypatch.setattr(fragmod, "_scatter_words", spy_w)
+        monkeypatch.setattr(fragmod, "_scatter_rows", spy_r)
+        # one bit in each of 32 rows: 32 words changed vs 32 full rows
+        f.import_bits(
+            np.arange(32, dtype=np.uint64),
+            rng.integers(0, 256 * 32, size=32),
+        )
+        f.device_bits()
+        assert calls["words"] == 1 and calls["rows"] == 0
+        f.check_invariants(device=True)
+
+    def test_untracked_mutation_degrades_safely(self):
+        import numpy as np
+        from pilosa_tpu.core.fragment import Fragment
+
+        f = Fragment(n_words=32)
+        f.set_bit(1, 5)
+        f.device_bits()
+        f.set_bit(1, 6)
+        f._touch(f._slot_of[1])  # untracked touch: must degrade, not corrupt
+        assert f._word_delta is None
+        f.device_bits()
+        f.check_invariants(device=True)
