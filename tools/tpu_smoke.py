@@ -1,0 +1,139 @@
+"""One-command end-to-end smoke check on the real chip.
+
+Runs the serving paths through the REAL Executor (not raw kernels) and
+asserts against host ground truth: gram-served singles, TopN, 2-level
+GroupBy, BSI aggregates + range counts, sustained ingest with the op
+log + snapshot store attached, and reopen-from-disk coherence.  Prints
+one PASS line per surface; exits non-zero on any mismatch.
+
+    python tools/tpu_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.storage.fragmentfile import FragmentFile, SnapshotQueue
+
+
+def main() -> int:
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform} ({jax.devices()[0]})")
+    if platform != "tpu" and "--allow-cpu" not in sys.argv:
+        # a relay outage silently falls back to CPU; an ALL PASS from
+        # there would be exactly the misleading evidence this tool
+        # exists to prevent
+        print("FAIL: not on TPU (pass --allow-cpu to run anyway)")
+        return 1
+    rng = np.random.default_rng(5)
+
+    # -- serving paths through Executor.execute -------------------------
+    h = Holder()
+    idx = h.create_index("s")
+    idx.create_field("f")
+    idx.create_field("g")
+    idx.create_field("v", FieldOptions(field_type="int", min_=-9999, max_=9999))
+    ex = Executor(h)
+    width = h.n_words * 32
+    writes = []
+    rows_f: dict[int, set] = {}
+    for row in range(8):
+        cols = rng.integers(0, 2 * width, size=150)
+        rows_f[row] = set(int(c) for c in cols)
+        writes += [f"Set({int(c)}, f={row})" for c in cols]
+    rows_g: dict[int, set] = {}
+    for row in range(4):
+        cols = rng.integers(0, 2 * width, size=100)
+        rows_g[row] = set(int(c) for c in cols)
+        writes += [f"Set({int(c)}, g={row})" for c in cols]
+    vals: dict[int, int] = {}
+    for c in rng.choice(2 * width, size=300, replace=False):
+        vals[int(c)] = int(rng.integers(-9999, 9999))
+        writes.append(f"Set({int(c)}, v={vals[int(c)]})")
+    ex.execute("s", " ".join(writes))
+
+    q = "Count(Intersect(Row(f=0), Row(f=1)))"
+    want = len(rows_f[0] & rows_f[1])
+    for _ in range(8):
+        assert ex.execute("s", q)[0] == want
+    assert ex.gram_cache_hits >= 1
+    print("PASS gram-served singles")
+
+    top = ex.execute("s", "TopN(f, n=3)")[0]
+    by_count = sorted(rows_f, key=lambda r: (-len(rows_f[r]), r))
+    assert [p.id for p in top] == by_count[:3]
+    ex.execute("s", "TopN(f, n=3)")
+    assert ex.rowcount_cache_hits >= 1
+    print("PASS TopN (served)")
+
+    gb = {
+        tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+        for gc in ex.execute("s", "GroupBy(Rows(f), Rows(g))")[0]
+    }
+    for fr, fcols in rows_f.items():
+        for gr, gcols in rows_g.items():
+            n = len(fcols & gcols)
+            assert gb.get((("f", fr), ("g", gr)), 0) == n, (fr, gr)
+    print("PASS GroupBy vs ground truth")
+
+    s = ex.execute("s", "Sum(field=v)")[0]
+    assert s.value == sum(vals.values()) and s.count == len(vals)
+    n = ex.execute("s", "Count(Row(v < 0))")[0]
+    assert n == sum(1 for v in vals.values() if v < 0)
+    print("PASS BSI Sum + range count")
+
+    # write invalidation across every cache
+    free = next(c for c in range(10**6) if c not in rows_f[0])
+    ex.execute("s", f"Set({free}, f=0) Set({free}, f=1)")
+    assert ex.execute("s", q)[0] == want + 1
+    print("PASS write invalidation")
+
+    # -- sustained ingest + reopen --------------------------------------
+    W = 4096
+    with tempfile.TemporaryDirectory() as d:
+        sq = SnapshotQueue(workers=2)
+        frag = Fragment(n_words=W)
+        store = FragmentFile(frag, os.path.join(d, "frag"), sq)
+        store.open()
+        truth = set()
+        t0 = time.perf_counter()
+        for _ in range(4):
+            r = rng.integers(0, 50, size=25_000).astype(np.uint64)
+            c = rng.integers(0, W * 32, size=25_000)
+            frag.import_bits(r, c)
+            truth.update(zip(r.tolist(), c.tolist()))
+            frag.device_bits()
+        sq.await_all()
+        rate = 100_000 / (time.perf_counter() - t0)
+        frag.check_invariants(device=True)
+        sq.stop()
+        store.close()
+        frag2 = Fragment(n_words=W)
+        store2 = FragmentFile(frag2, os.path.join(d, "frag"))
+        store2.open()
+        got = set()
+        for r, mask in frag2.to_host_rows().items():
+            bits = np.unpackbits(mask.view(np.uint8), bitorder="little")
+            got.update((r, int(c)) for c in np.nonzero(bits)[0])
+        assert got == truth
+        store2.close()
+    print(f"PASS sustained ingest + reopen ({rate:.0f} bits/s)")
+    print("ALL PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
