@@ -212,33 +212,71 @@ def main() -> None:
     batched_qps = B / batched_t
     checksum = int(counts[-1].sum())
 
-    # -- sequential Count(Intersect): latency mode (includes relay RTT) ----
-    @jax.jit
-    def _count_pair(bits, ra, rb):
-        a = bits[:, ra]
-        b = bits[:, rb]
-        return jnp.sum(lax.population_count(a & b).astype(jnp.int32), axis=-1)
+    # -- sequential Count(Intersect): cold latency mode, END TO END --------
+    # One lone query at a time through Executor.execute (parse included)
+    # against a REAL full-size index, with the warm-up threshold pushed
+    # out of reach so EVERY query is served cold — this measures the
+    # host latency tier (fragment host mirrors + fused native
+    # and+popcount, native/hostops.cpp), the framework's designed path
+    # for a lone cold query (the reference's executor.go:1792 through
+    # roaring.go:568).  No cache is consulted or installed.
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.view import VIEW_STANDARD
+    from pilosa_tpu.exec.executor import Executor as _Executor
 
-    _sync(_count_pair(bits, int(ras[0]), int(rbs[0])))  # compile
-    n_seq = 10
+    h_seq = Holder(n_words=W)
+    idx_seq = h_seq.create_index("seq")
+    f_seq = idx_seq.create_field("f")
+    v_seq = f_seq.create_view_if_not_exists(VIEW_STANDARD)
+    seq_rng = np.random.default_rng(13)
+    sub_shards = max(1, S // 16)
+    sub = None  # first sub_shards kept for the CPU baseline
+    for s in range(S):
+        words = seq_rng.integers(
+            0, 2**32, size=(R, W), dtype=np.uint32
+        ) & seq_rng.integers(0, 2**32, size=(R, W), dtype=np.uint32)
+        frag = v_seq.create_fragment_if_not_exists(s)
+        for r in range(R):
+            frag.set_row_words(r, words[r])
+        if s == 0:
+            sub = np.empty((sub_shards, R, W), dtype=np.uint32)
+        if s < sub_shards:
+            sub[s] = words
+    ex_seq = _Executor(h_seq)
+    ex_seq._PAIR_SINGLE_WARM = 10**9  # keep every query cold
+    n_seq = 30
+    q0 = f"Count(Intersect(Row(f={int(ras[0])}), Row(f={int(rbs[0])})))"
+    ex_seq.execute("seq", q0)  # build native lib / warm code paths once
     t0 = time.perf_counter()
     for i in range(n_seq):
-        _sync(_count_pair(bits, int(ras[i % B]), int(rbs[i % B])))
+        ex_seq.execute(
+            "seq",
+            f"Count(Intersect(Row(f={int(ras[i % B])}), Row(f={int(rbs[i % B])})))",
+        )
     seq_qps = n_seq / (time.perf_counter() - t0)
 
     # -- cache-served sequential: the executor's steady-state for repeat
-    # singles.  After warm-up, Executor._pair_single_ready engages the
-    # stack path and _field_gram answers every lone Count(op(Row,Row))
-    # from the cached HOST gram — zero device work, no relay RTT (the
-    # reference's ranked cache serving counts from memory, cache.go).
-    # Measured as the same per-query host computation that path runs.
-    g_host = np.asarray(grams[0]).astype(np.int64)
-    n_sv = 2000
+    # singles, measured as FULL Executor.execute round trips (parse
+    # included).  After warm-up the stack+gram investment engages and
+    # every lone Count(op(Row,Row)) is answered from the cached HOST
+    # gram — zero device work per query (the reference's ranked cache
+    # serving counts from memory, cache.go).  Per-query cost is
+    # index-size-independent by design (that is the point of the
+    # cache), so the warm-up runs over a shard subset to keep the
+    # one-time stack upload through the relay bounded.
+    srv_shards = list(range(sub_shards))
+    qwarm = f"Count(Intersect(Row(f={int(ras[0])}), Row(f={int(rbs[0])})))"
+    ex_srv = _Executor(h_seq)
+    for _ in range(ex_srv._PAIR_SINGLE_WARM + 2):
+        ex_srv.execute("seq", qwarm, shards=srv_shards)
+    n_sv = 400
     t0 = time.perf_counter()
     for i in range(n_sv):
         j = i % B
-        kernels.pair_counts_from_gram(
-            g_host, ras[j : j + 1], rbs[j : j + 1], "intersect"
+        ex_srv.execute(
+            "seq",
+            f"Count(Intersect(Row(f={int(ras[j])}), Row(f={int(rbs[j])})))",
+            shards=srv_shards,
         )
     seq_served_qps = n_sv / (time.perf_counter() - t0)
 
@@ -356,12 +394,25 @@ def main() -> None:
     ing_rng = np.random.default_rng(11)
     ing_rows = ing_rng.integers(0, 64, size=n_pos).astype(np.uint64)
     ing_cols = ing_rng.integers(0, W * 32, size=n_pos)
-    frag = Fragment(n_words=W)
-    t0 = time.perf_counter()
-    frag.import_bits(ing_rows, ing_cols)
-    frag.device_bits()  # include the HBM upload in the ingest cost
-    ingest_bits_s = n_pos / (time.perf_counter() - t0)
+    with tempfile.TemporaryDirectory() as d0:
+        sq0 = SnapshotQueue(workers=2)
+        frag = Fragment(n_words=W)
+        store0 = FragmentFile(frag, os.path.join(d0, "frag"), sq0)
+        store0.open()
+        frag.store = store0
+        t0 = time.perf_counter()
+        frag.import_bits(ing_rows, ing_cols)
+        frag.device_bits()  # include the HBM upload in the ingest cost
+        sq0.await_all()
+        ingest_bits_s = n_pos / (time.perf_counter() - t0)
+        sq0.stop()
+        store0.close()
 
+    # Sustained: multi-batch run through the full durability path —
+    # op-record WAL appends (checksummed, one fsync per batch),
+    # background snapshots, and ONE final device refresh (the serving
+    # copy syncs lazily on the next query; that is the design, so the
+    # steady state pays it once per convergence, not per batch).
     n_batches, batch = (8, 500_000) if accel else (4, 50_000)
     with tempfile.TemporaryDirectory() as d:
         sq = SnapshotQueue(workers=2)
@@ -375,15 +426,77 @@ def main() -> None:
         for bi in range(n_batches):
             sl = slice(bi * batch, (bi + 1) * batch)
             frag2.import_bits(srows[sl], scols[sl])
-            frag2.device_bits()  # keep the serving copy fresh
         sq.await_all()  # snapshots are part of the steady-state cost
+        frag2.device_bits()  # converge the serving copy once
         sustained_bits_s = (n_batches * batch) / (time.perf_counter() - t0)
         sq.stop()
         store.close()
 
+    # CPU anchor for ingest (vs_baseline): the same semantic work —
+    # dedup + mirror merge + changed-position extraction + checksummed
+    # WAL append with per-batch fsync + snapshot rewrite past MaxOpN —
+    # in straightforward single-stream vectorized numpy + stdlib IO,
+    # standing in for the reference's Go import path
+    # (fragment.go:1995-2280 bulkImport -> roaring.go:1463
+    # ImportRoaringBits + op log) like the query baseline's numpy
+    # popcount stands in for its roaring word loops.
+    def _cpu_anchor_ingest(rows, cols, n_batches, batch, W):
+        import zlib
+
+        width = W * 32
+        mirror = np.zeros((64, W), dtype=np.uint32)
+        ops_since_snap = 0
+        with tempfile.TemporaryDirectory() as d2:
+            path = os.path.join(d2, "anchor")
+            fh = open(path, "wb")
+            t0 = time.perf_counter()
+            for bi in range(n_batches):
+                sl = slice(bi * batch, (bi + 1) * batch)
+                r = rows[sl].astype(np.int64)
+                c = cols[sl]
+                key = r * width + c
+                ukey = np.unique(key)
+                ur = ukey // width
+                uc = ukey % width
+                w = (uc >> 5).astype(np.int64)
+                bit = np.uint32(1) << (uc & 31).astype(np.uint32)
+                pre = mirror[ur, w]
+                newly = (pre & bit) == 0
+                np.bitwise_or.at(mirror, (ur, w), bit)
+                positions = ukey[newly].astype(np.uint64)
+                payload = positions.tobytes()
+                fh.write(
+                    len(payload).to_bytes(8, "little")
+                    + zlib.crc32(payload).to_bytes(4, "little")
+                    + payload
+                )
+                fh.flush()
+                os.fsync(fh.fileno())
+                ops_since_snap += len(positions)
+                if ops_since_snap > 10_000:  # MaxOpN snapshot rewrite
+                    snap = os.path.join(d2, "anchor.snap")
+                    with open(snap, "wb") as sf:
+                        packed = np.nonzero(
+                            np.unpackbits(
+                                mirror.view(np.uint8), bitorder="little"
+                            )
+                        )[0].astype(np.uint64)
+                        sf.write(packed.tobytes())
+                        sf.flush()
+                        os.fsync(sf.fileno())
+                    ops_since_snap = 0
+                    fh.close()
+                    fh = open(path, "wb")
+            fh.close()
+            return (n_batches * batch) / (time.perf_counter() - t0)
+
+    cpu_ingest_bits_s = _cpu_anchor_ingest(srows, scols, n_batches, batch, W)
+
     # -- CPU baseline (numpy popcount on a shard subset, scaled) ------------
-    S_sub = max(1, S // 16)
-    sub = np.asarray(bits[:S_sub])  # [S_sub, R, W]
+    # ``sub`` is the host-generated shard subset of the sequential index
+    # (same shape/density as the device tensor), so the baseline and the
+    # host latency tier run against identical data.
+    S_sub = sub_shards
     qa, qb = int(ras[0]), int(rbs[0])
     # per-query: AND + popcount of two rows across all shards; best-of-5
     # (wall clock on a shared host is noisy upward, never downward)
@@ -413,7 +526,12 @@ def main() -> None:
         "bsi_range_qps": round(bsi_qps, 1),
         "bsi_range_vs_baseline": round(bsi_vs, 1),
         "ingest_bits_s": round(ingest_bits_s, 0),
+        "ingest_vs_baseline": round(ingest_bits_s / cpu_ingest_bits_s, 1),
         "sustained_ingest_bits_s": round(sustained_bits_s, 0),
+        "sustained_ingest_vs_baseline": round(
+            sustained_bits_s / cpu_ingest_bits_s, 1
+        ),
+        "cpu_ingest_bits_s": round(cpu_ingest_bits_s, 0),
         "cpu_baseline_qps": round(cpu_qps, 1),
         "platform": jax.devices()[0].platform,
         "index_bits": n_bits,

@@ -1,0 +1,191 @@
+// Host latency-tier bitmap kernels.
+//
+// The serving architecture splits by regime: the TPU runs the
+// throughput tier (batched gram launches, full-index scans —
+// pilosa_tpu/ops/kernels.py), while a LONE cold query is answered from
+// the fragment's authoritative host mirror, because a single
+// row-pair count moves ~2 rows * n_shards of words and a host memory
+// pass beats a device dispatch + result round trip at that size.  The
+// reference serves the same shape from its roaring word loops
+// (reference roaring.go:568 intersectionCountBitmapBitmap,
+// roaring.go:5057 popcount); these are the dense-word equivalents,
+// fused (no AND temporary) and threaded across shards by the caller
+// (ctypes releases the GIL, so Python-thread fan-out scales on
+// multi-core hosts).
+//
+// C ABI only — bound via ctypes (pilosa_tpu/ops/_hostops.py).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+inline uint64_t load64(const uint8_t* p) {
+    uint64_t x;
+    std::memcpy(&x, p, 8);  // unaligned-safe; compiles to one mov
+    return x;
+}
+
+inline uint64_t popcnt(uint64_t x) {
+#if defined(__GNUC__) || defined(__clang__)
+    return static_cast<uint64_t>(__builtin_popcountll(x));
+#else
+    x = x - ((x >> 1) & 0x5555555555555555ULL);
+    x = (x & 0x3333333333333333ULL) + ((x >> 2) & 0x3333333333333333ULL);
+    x = (x + (x >> 4)) & 0x0F0F0F0F0F0F0F0FULL;
+    return (x * 0x0101010101010101ULL) >> 56;
+#endif
+}
+
+enum Op { OP_AND = 0, OP_OR = 1, OP_ANDNOT = 2, OP_XOR = 3 };
+
+inline uint64_t apply(uint64_t a, uint64_t b, int op) {
+    switch (op) {
+        case OP_AND: return a & b;
+        case OP_OR: return a | b;
+        case OP_ANDNOT: return a & ~b;
+        default: return a ^ b;
+    }
+}
+
+// Fused op+popcount over n_words uint32 words (single pass, no
+// temporary).  Unrolled 4x64-bit; the tail runs word-at-a-time.
+template <int OP>
+uint64_t pair_count_t(const uint8_t* a, const uint8_t* b, size_t n_words) {
+    size_t n8 = n_words / 2;  // 64-bit lanes
+    size_t i = 0;
+    uint64_t c0 = 0, c1 = 0, c2 = 0, c3 = 0;
+    for (; i + 4 <= n8; i += 4) {
+        c0 += popcnt(apply(load64(a + 8 * i), load64(b + 8 * i), OP));
+        c1 += popcnt(apply(load64(a + 8 * (i + 1)), load64(b + 8 * (i + 1)), OP));
+        c2 += popcnt(apply(load64(a + 8 * (i + 2)), load64(b + 8 * (i + 2)), OP));
+        c3 += popcnt(apply(load64(a + 8 * (i + 3)), load64(b + 8 * (i + 3)), OP));
+    }
+    uint64_t c = c0 + c1 + c2 + c3;
+    for (; i < n8; i++) {
+        c += popcnt(apply(load64(a + 8 * i), load64(b + 8 * i), OP));
+    }
+    if (n_words & 1) {  // odd uint32 tail
+        uint32_t xa, xb;
+        std::memcpy(&xa, a + 8 * n8, 4);
+        std::memcpy(&xb, b + 8 * n8, 4);
+        c += popcnt(apply(xa, xb, OP));
+    }
+    return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// popcount of n_words uint32 words
+uint64_t ph_popcount(const uint8_t* a, size_t n_words) {
+    size_t n8 = n_words / 2;
+    size_t i = 0;
+    uint64_t c0 = 0, c1 = 0, c2 = 0, c3 = 0;
+    for (; i + 4 <= n8; i += 4) {
+        c0 += popcnt(load64(a + 8 * i));
+        c1 += popcnt(load64(a + 8 * (i + 1)));
+        c2 += popcnt(load64(a + 8 * (i + 2)));
+        c3 += popcnt(load64(a + 8 * (i + 3)));
+    }
+    uint64_t c = c0 + c1 + c2 + c3;
+    for (; i < n8; i++) c += popcnt(load64(a + 8 * i));
+    if (n_words & 1) {
+        uint32_t x;
+        std::memcpy(&x, a + 8 * n8, 4);
+        c += popcnt(x);
+    }
+    return c;
+}
+
+// fused op(a,b)+popcount; op: 0=and 1=or 2=andnot 3=xor
+uint64_t ph_pair_count(const uint8_t* a, const uint8_t* b, size_t n_words,
+                       int op) {
+    switch (op) {
+        case OP_AND: return pair_count_t<OP_AND>(a, b, n_words);
+        case OP_OR: return pair_count_t<OP_OR>(a, b, n_words);
+        case OP_ANDNOT: return pair_count_t<OP_ANDNOT>(a, b, n_words);
+        default: return pair_count_t<OP_XOR>(a, b, n_words);
+    }
+}
+
+// op(a,b) materialized into out (for host Row algebra without numpy's
+// ufunc dispatch overhead on the hot path); out may alias a.
+void ph_pair_op(const uint8_t* a, const uint8_t* b, uint8_t* out,
+                size_t n_words, int op) {
+    size_t n8 = n_words / 2;
+    for (size_t i = 0; i < n8; i++) {
+        uint64_t r = apply(load64(a + 8 * i), load64(b + 8 * i), op);
+        std::memcpy(out + 8 * i, &r, 8);
+    }
+    if (n_words & 1) {
+        uint32_t xa, xb;
+        std::memcpy(&xa, a + 8 * n8, 4);
+        std::memcpy(&xb, b + 8 * n8, 4);
+        uint32_t r = static_cast<uint32_t>(
+            apply(xa, xb, op) & 0xFFFFFFFFULL);
+        std::memcpy(out + 8 * n8, &r, 4);
+    }
+}
+
+// Extract set-bit offsets of an n_words uint32 vector into out
+// (caller sized it via ph_popcount), each offset + base.  The
+// classic ctz loop — the hot part of snapshot encoding and op-record
+// position extraction (reference roaring.go walks containers the same
+// way when it serializes).  Bit addressing: word w bit b -> w*32+b,
+// which under little-endian 64-bit lanes is lane*64 + ctz.
+size_t ph_extract(const uint8_t* words, size_t n_words, uint64_t base,
+                  uint64_t* out) {
+    size_t k = 0;
+    size_t n8 = n_words / 2;
+    for (size_t i = 0; i < n8; i++) {
+        uint64_t x = load64(words + 8 * i);
+        while (x) {
+#if defined(__GNUC__) || defined(__clang__)
+            uint64_t b = static_cast<uint64_t>(__builtin_ctzll(x));
+#else
+            uint64_t b = 0;
+            while (!((x >> b) & 1)) b++;
+#endif
+            out[k++] = base + i * 64 + b;
+            x &= x - 1;
+        }
+    }
+    if (n_words & 1) {
+        uint32_t x;
+        std::memcpy(&x, words + 8 * n8, 4);
+        while (x) {
+#if defined(__GNUC__) || defined(__clang__)
+            uint32_t b = static_cast<uint32_t>(__builtin_ctz(x));
+#else
+            uint32_t b = 0;
+            while (!((x >> b) & 1)) b++;
+#endif
+            out[k++] = base + n8 * 64 + b;
+            x &= x - 1;
+        }
+    }
+    return k;
+}
+
+// Batched fused pair counts over many same-length row pairs — the
+// multi-shard latency-tier fan (one call per chunk; the caller spreads
+// chunks across Python threads only when cores allow).  Addresses
+// arrive as uint64 values in flat arrays (numpy computes
+// base+slot*stride vectorized, so Python builds NO per-row ctypes
+// objects) and the sum is reduced natively.
+uint64_t ph_pair_count_addr(const uint64_t* addr_a, const uint64_t* addr_b,
+                            size_t n_pairs, size_t n_words, int op) {
+    uint64_t total = 0;
+    for (size_t i = 0; i < n_pairs; i++) {
+        total += ph_pair_count(
+            reinterpret_cast<const uint8_t*>(static_cast<uintptr_t>(addr_a[i])),
+            reinterpret_cast<const uint8_t*>(static_cast<uintptr_t>(addr_b[i])),
+            n_words, op);
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -73,9 +73,12 @@ class DistributedExecutor:
         # executed.  translator only applies when WE build the executor —
         # a supplied one keeps its own.
         if local_executor is not None and translator is not None:
-            assert local_executor.translator is translator, (
-                "local_executor was built with a different translator"
-            )
+            if local_executor.translator is not translator:
+                # hard error (not assert: compiled out under -O) — a
+                # mismatched translator would silently mistranslate keys
+                raise ValueError(
+                    "local_executor was built with a different translator"
+                )
         self.local = local_executor or Executor(holder, translator=translator)
         # Lazily created: single-node paths never pay for pool threads.
         # Request threads (ThreadingHTTPServer) race on init and against
@@ -165,7 +168,48 @@ class DistributedExecutor:
         all_shards = self.local._shards_for(idx, shards)
         if call.name in _SHARD_WRITES:
             return self._execute_shard_write(index_name, idx, call, all_shards)
+        inner = (
+            call.children[0]
+            if call.name == "Options" and call.children
+            else call
+        )
+        if inner.name == "TopN":
+            return self._execute_topn_distributed(
+                index_name, idx, call, inner, all_shards
+            )
         return self._map_reduce(index_name, idx, call, all_shards)
+
+    def _execute_topn_distributed(
+        self, index_name: str, idx, call: Call, inner: Call,
+        shards: list[int],
+    ) -> list[Pair]:
+        """Two-phase distributed TopN (reference executor.go:884-999):
+        phase 1 gathers each node's top-n candidates (per-node lists are
+        threshold-filtered and truncated to n, so a row ranked n+1 on
+        every node but top-k globally would be missed); phase 2
+        re-queries ALL nodes for the exact counts of the union of
+        candidate ids (``ids=`` disables per-node truncation), so the
+        final merge ranks every candidate by its true global count
+        before truncating."""
+        partials = self._map_partials(index_name, idx, call, shards)
+        n, has_n = inner.uint_arg("n")
+        _, has_ids = inner.uint_slice_arg("ids")
+        if not has_n or not n or has_ids or self._single:
+            return _reduce(call, partials)
+        cand = sorted({p.id for part in partials for p in (part or [])})
+        if not cand:
+            return []
+        refetch = call.clone()
+        target = (
+            refetch.children[0]
+            if refetch.name == "Options" and refetch.children
+            else refetch
+        )
+        target.args["ids"] = cand
+        target.args.pop("n", None)
+        partials2 = self._map_partials(index_name, idx, refetch, shards)
+        merged = _reduce_topn(refetch, partials2)  # no n -> full merge
+        return merged[:n]
 
     def _shard_of_write(self, call: Call) -> int:
         col, ok = call.uint_arg("_col")
@@ -255,6 +299,11 @@ class DistributedExecutor:
     def _map_reduce(
         self, index_name: str, idx, call: Call, shards: list[int]
     ) -> Any:
+        return _reduce(call, self._map_partials(index_name, idx, call, shards))
+
+    def _map_partials(
+        self, index_name: str, idx, call: Call, shards: list[int]
+    ) -> list[Any]:
         pql_text = str(call)
         span = tracing.start_span("executor.mapReduce").set_tag("call", call.name)
         span.set_tag("shards", len(shards))
@@ -297,7 +346,7 @@ class DistributedExecutor:
                         pending.extend(nshards)
             if not partials:
                 partials = [self.local._execute_call(idx, call, [])]
-            return _reduce(call, partials)
+            return partials
 
     def _group_by_live_owner(
         self, index_name: str, shards: list[int], bad_nodes: set[str]

@@ -531,19 +531,18 @@ class Fragment:
             # Group by row directly (never via row*width+col positions,
             # which would wrap uint64 for hashed row ids).
             row_ids, inverse = np.unique(rows, return_inverse=True)
-            words = np.zeros((len(row_ids), self.n_words), dtype=np.uint32)
-            np.bitwise_or.at(
-                words,
-                (inverse, (cols >> 5).astype(np.int64)),
-                np.uint32(1) << (cols & 31).astype(np.uint32),
-            )
             if clear:
                 keep = np.array(
                     [int(r) in self._slot_of for r in row_ids], dtype=bool
                 )
-                row_ids, words = row_ids[keep], words[keep]
-                if not len(row_ids):
+                if not keep.any():
                     return 0
+                sel = keep[inverse]
+                inverse = np.cumsum(keep)[inverse[sel]] - 1
+                cols = cols[sel]
+                row_ids = row_ids[keep]
+                for r in row_ids:  # BEFORE mutation: mirror/WAL atomicity
+                    self._check_persistable(int(r))
                 slots = np.array(
                     [self._slot_of[int(r)] for r in row_ids], dtype=np.int64
                 )
@@ -554,41 +553,68 @@ class Fragment:
                     [self._slot(int(r), create=True) for r in row_ids],
                     dtype=np.int64,
                 )
-            sub = self._host[slots]
+            # ONE sort (np.unique over flattened keys) drives everything:
+            # dedup, per-word grouping, changed-bit detection and WAL
+            # positions all fall out as vector passes over the sorted
+            # keys — no dense [rows, n_words] mask matrix and no
+            # unbuffered ufunc.at scalar loop (the previous hot spots).
+            width = self.n_words * 32
+            key = inverse.astype(np.int64) * width + cols
+            ukey = np.unique(key)
+            urow = ukey // width  # index into row_ids/slots
+            ucol = ukey % width
+            bitvals = np.uint32(1) << (ucol & 31).astype(np.uint32)
+            # group bits into their words: wkey = urow*n_words + word
+            wkey = ukey >> 5
+            starts = np.flatnonzero(
+                np.r_[True, wkey[1:] != wkey[:-1]]
+            )
+            wordvals = np.bitwise_or.reduceat(bitvals, starts)
+            uw = wkey[starts]
+            flat = self._host.reshape(-1)
+            flat_idx = slots[uw // self.n_words] * self.n_words + uw % self.n_words
+            pre_words = flat[flat_idx]
             if clear:
-                mask = words & sub
-                self._host[slots] = sub & ~words
+                changed_words = wordvals & pre_words
+                flat[flat_idx] = pre_words & ~wordvals
             else:
-                mask = words & ~sub
-                self._host[slots] = sub | words
-            per_row = np.bitwise_count(mask).sum(axis=1, dtype=np.int64)
-            changed_idx = np.nonzero(per_row)[0]
-            for i in changed_idx:
-                self._dirty.add(int(slots[i]))
-            if len(changed_idx) and self._word_delta is not None:
-                # exact changed words for the whole batch in one nonzero
-                ci, wi = np.nonzero(mask[changed_idx])
-                self._delta_note(
-                    slots[changed_idx][ci] * self.n_words
-                    + wi.astype(np.int64)
-                )
-            if self.store is not None and len(changed_idx):
-                # one vectorized unpack for the whole batch's op records
-                if clear:
-                    self.store.log_remove_masks(
-                        row_ids[changed_idx], mask[changed_idx]
+                changed_words = wordvals & ~pre_words
+                flat[flat_idx] = pre_words | wordvals
+            # per-bit changed flags via the pre-update word of each key
+            pre_of_key = pre_words[np.searchsorted(uw, wkey)]
+            if clear:
+                newly = (pre_of_key & bitvals) != 0
+            else:
+                newly = (pre_of_key & bitvals) == 0
+            n_changed = int(np.count_nonzero(newly))
+            if n_changed:
+                ch_row = urow[newly]
+                per_row = np.bincount(ch_row, minlength=len(row_ids))
+                changed_idx = np.nonzero(per_row)[0]
+                for i in changed_idx:
+                    self._dirty.add(int(slots[i]))
+                if self._word_delta is not None:
+                    self._delta_note(flat_idx[changed_words != 0])
+                if self.store is not None:
+                    # WAL positions computed directly from the sorted
+                    # keys (row-major, ascending — same record order the
+                    # mask-unpack path produced); rows were
+                    # check_row'd before the mutation above
+                    positions = (
+                        row_ids[ch_row].astype(np.uint64)
+                        * np.uint64(width)
+                        + ucol[newly].astype(np.uint64)
                     )
-                else:
-                    self.store.log_add_masks(
-                        row_ids[changed_idx], mask[changed_idx]
-                    )
-            if len(changed_idx):
+                    if clear:
+                        self.store.log_remove_positions(positions)
+                    else:
+                        self.store.log_add_positions(positions)
                 self._counts = None
                 self.version += 1
                 self.op_n += len(changed_idx)
                 if self.on_op is not None:
                     self.on_op(self)
-            return int(per_row.sum())
+            return n_changed
 
     def set_mutex(self, row: int, col: int) -> bool:
         """Mutex-field write: clear col in every other row, set (row, col)
@@ -806,6 +832,30 @@ class Fragment:
             if s is None:
                 return 0
             return bitops.popcount_host(self._host[s])
+
+    def row_pair_count(self, ra: int, rb: int, op: str) -> int:
+        """Fused ``popcount(op(row_a, row_b))`` from the host mirror,
+        zero-copy under the fragment lock — the latency tier for a lone
+        ``Count(op(Row, Row))`` (reference roaring.go:568; the batched
+        throughput tier is the device gram, ops/kernels.py).  ``op`` is
+        one of intersect/union/difference/xor; absent rows count as
+        zero rows."""
+        with self._lock:
+            sa = self._slot_of.get(ra)
+            sb = self._slot_of.get(rb)
+            if sa is None and sb is None:
+                return 0
+            if sa is None:
+                if op == "difference":
+                    return 0
+                if op == "intersect":
+                    return 0
+                return bitops.popcount_host(self._host[sb])
+            if sb is None:
+                if op == "intersect":
+                    return 0
+                return bitops.popcount_host(self._host[sa])
+            return bitops.pair_count_host(self._host[sa], self._host[sb], op)
 
     def row_counts(self) -> tuple[list[int], np.ndarray]:
         """(row_ids, per-row popcounts) over existing rows — the TopN

@@ -15,6 +15,7 @@ Union/Xor/Not/Shift (executor.go:653-680)."""
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import weakref
 from datetime import datetime
@@ -472,7 +473,11 @@ class Executor:
                             entry["gram"] = (bits, g)
                     return g, {s: s for s in uniq}
             else:
-                entry["gram_misses"] = entry.get("gram_misses", 0) + 1
+                # under the stack lock: _refresh pops entries under the
+                # same lock, so the increment can't land on a stale entry
+                lock = vars(field).setdefault("_stack_lock", threading.RLock())
+                with lock:
+                    entry["gram_misses"] = entry.get("gram_misses", 0) + 1
         g = kernels.pair_gram(bits, uniq)
         if g is None:
             return None, None
@@ -643,7 +648,12 @@ class Executor:
                                     slots.pop(k, None)
                         return g[np.ix_(sub1, sub2)]
                 else:
-                    misses[f2.name] = misses.get(f2.name, 0) + 1
+                    # same-lock discipline as the install path above
+                    lock = vars(f1).setdefault(
+                        "_stack_lock", threading.RLock()
+                    )
+                    with lock:
+                        misses[f2.name] = misses.get(f2.name, 0) + 1
         return kernels.cross_pair_gram(bits1, bits2, sub1, sub2)
 
     def _batch_pair_counts(
@@ -1199,6 +1209,12 @@ class Executor:
         return child.shift(n if ok else 0)
 
     def _field_row(self, field: Field | None, row_id: int, shards: list[int], view: str = VIEW_STANDARD) -> Row:
+        """Row segments from the HOST mirrors — the per-call path is the
+        latency tier, and the authoritative host copy answers a lone
+        read without a device upload or result round trip (the
+        throughput tier — batched grams, stacks — lives in
+        _batch_pair_counts/_batch_general).  Downstream Row algebra and
+        counts dispatch per segment type (exec/result.py)."""
         out = Row(n_words=self.holder.n_words)
         if field is None:
             return out
@@ -1208,7 +1224,7 @@ class Executor:
         for shard in shards:
             frag = v.fragment(shard)
             if frag is not None:
-                out.segments[shard] = frag.row_device(row_id)
+                out.segments[shard] = frag.row_words_host(row_id)
         return out
 
     def _execute_row(self, idx: Index, call: Call, shards: list[int]) -> Row:
@@ -1415,7 +1431,147 @@ class Executor:
                 n = self._bitmap_call(idx, child, shard_list).count()
                 put(n)
                 return n
+        # Latency tier: a lone Count over a pair or single row — the
+        # gram fast path has already declined (cold field / single
+        # query), so answer from the host mirrors with the fused native
+        # kernel, zero copies (reference executor.go:1792 Count through
+        # roaring.go:568's word loop).
+        m = self._match_pair_count(idx, call)
+        if m is not None:
+            fname, op, ra, rb = m
+            view = idx.field(fname).view(VIEW_STANDARD)
+            return self._host_pair_count(view, ra, rb, op, shard_list)
+        n = self._match_single_row_count(idx, child)
+        if n is not None:
+            field, row_id = n
+            view = field.view(VIEW_STANDARD)
+            if view is not None:
+                # popcount(a) == popcount(a & a): ride the same fused
+                # batched path as pair counts
+                return self._host_pair_count(
+                    view, row_id, row_id, "intersect", shard_list
+                )
+            return 0
         return self._bitmap_call(idx, child, shard_list).count()
+
+    @staticmethod
+    def _match_single_row_count(idx: Index, child: Call):
+        """(field, row_id) when ``child`` is a plain ``Row(f=<id>)`` over
+        a set-like field's standard view; None otherwise."""
+        if child.name != "Row" or child.children:
+            return None
+        fname = child.field_arg()
+        if fname is None or set(child.args) != {fname}:
+            return None
+        v = child.args.get(fname)
+        if not isinstance(v, int) or isinstance(v, bool):
+            return None
+        field = idx.field(fname)
+        if field is None or field.field_type == FIELD_TYPE_INT:
+            return None
+        return field, v
+
+    # shards per latency-tier fan-out chunk; also the engage threshold —
+    # below it the per-thread handoff costs more than it saves
+    _HOST_FANOUT_CHUNK = 24
+
+    def _host_pair_count(self, view, ra: int, rb: int, op: str, shard_list: list[int]) -> int:
+        """Sum of fused host pair counts across shards, batched into ONE
+        native call per chunk (per-shard ctypes crossings would cost
+        more than the count itself at 100+ shards) and fanned across a
+        small thread pool when the host has cores to use (the native
+        kernel releases the GIL, so shard chunks count in parallel —
+        the worker-pool role of reference executor.go:2557-2611)."""
+        if view is None:
+            return 0
+        frags = [
+            f for f in (view.fragment(s) for s in shard_list) if f is not None
+        ]
+        if not frags:
+            return 0
+        cores = os.cpu_count() or 1
+        if cores > 1 and len(frags) >= 2 * self._HOST_FANOUT_CHUNK:
+            chunks = [
+                frags[i : i + self._HOST_FANOUT_CHUNK]
+                for i in range(0, len(frags), self._HOST_FANOUT_CHUNK)
+            ]
+            pool = self._host_tier_pool()
+            return sum(
+                pool.map(
+                    lambda ch: self._host_pair_count_chunk(ch, ra, rb, op),
+                    chunks,
+                )
+            )
+        return self._host_pair_count_chunk(frags, ra, rb, op)
+
+    @staticmethod
+    def _host_pair_count_chunk(frags, ra: int, rb: int, op: str) -> int:
+        """One fused native crossing for a chunk of fragments, with every
+        fragment's lock held through the call so counts read a
+        consistent snapshot (absent rows ride a shared zeros row, which
+        yields the zero-row semantics of every op).  Row addresses are
+        computed vectorized (base + slot*stride) so the whole fan costs
+        one ctypes call and zero per-row marshalling.  Falls back to the
+        per-fragment path when the native library is absent."""
+        import contextlib
+
+        from pilosa_tpu.ops import _hostops
+
+        if _hostops.load() is None:
+            return sum(f.row_pair_count(ra, rb, op) for f in frags)
+        n = len(frags)
+        n_words = frags[0].n_words
+        zeros = np.zeros(n_words, dtype=np.uint32)
+        zaddr = zeros.__array_interface__["data"][0]
+        bases = np.empty(n, dtype=np.uint64)
+        slots_a = np.empty(n, dtype=np.int64)
+        slots_b = np.empty(n, dtype=np.int64)
+        hosts = []  # keep every backing array alive through the call
+        with contextlib.ExitStack() as st:
+            for i, f in enumerate(frags):
+                st.enter_context(f._lock)
+                host = f._host
+                hosts.append(host)
+                bases[i] = host.__array_interface__["data"][0]
+                sa = f._slot_of.get(ra)
+                sb = f._slot_of.get(rb)
+                slots_a[i] = -1 if sa is None else sa
+                slots_b[i] = -1 if sb is None else sb
+            stride = np.uint64(n_words * 4)
+            addr_a = np.where(
+                slots_a < 0, np.uint64(zaddr),
+                bases + slots_a.astype(np.uint64) * stride,
+            )
+            addr_b = np.where(
+                slots_b < 0, np.uint64(zaddr),
+                bases + slots_b.astype(np.uint64) * stride,
+            )
+            total = _hostops.pair_count_addrs(addr_a, addr_b, n_words, op)
+        if total is None:  # race: library vanished; serial fallback
+            return sum(f.row_pair_count(ra, rb, op) for f in frags)
+        return total
+
+    # guards _host_pool creation: concurrent request threads must not
+    # each build (and leak) a pool — same discipline as
+    # DistributedExecutor._fanout_pool
+    _host_pool_lock = threading.Lock()
+
+    def _host_tier_pool(self):
+        """Lazily built, executor-lifetime thread pool for latency-tier
+        shard fan-out (never built on single-core hosts)."""
+        pool = getattr(self, "_host_pool", None)
+        if pool is None:
+            import concurrent.futures
+
+            with self._host_pool_lock:
+                pool = getattr(self, "_host_pool", None)
+                if pool is None:
+                    pool = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=min(8, os.cpu_count() or 1),
+                        thread_name_prefix="pilosa-hosttier",
+                    )
+                    self._host_pool = pool
+        return pool
 
     def _sum_filter(self, idx: Index, call: Call, shards: list[int]):
         if len(call.children) > 1:

@@ -31,6 +31,8 @@ class Row:
     def _words(self, shard: int, like) -> Any:
         seg = self.segments.get(shard)
         if seg is None:
+            if isinstance(like, np.ndarray):
+                return np.zeros_like(like)
             return jnp.zeros_like(like)
         return seg
 
@@ -68,29 +70,45 @@ class Row:
         """Per-shard shift (no cross-shard carry, matching the reference's
         per-shard Shift semantics, roaring.go:944)."""
         out = {
-            shard: bitops.shift_row(seg, n) for shard, seg in self.segments.items()
+            shard: (
+                bitops.shift_row_host(seg, n)
+                if isinstance(seg, np.ndarray)
+                else bitops.shift_row(seg, n)
+            )
+            for shard, seg in self.segments.items()
         }
         return Row(out, self.n_words)
 
     # -- materialization ----------------------------------------------------
+    #
+    # Segments are either device arrays (throughput-tier results) or
+    # host numpy arrays (latency-tier results served from the fragment
+    # mirrors); counts dispatch per segment so a host-tier Row never
+    # pays a device round trip.
+
+    @staticmethod
+    def _seg_count(seg) -> int:
+        if isinstance(seg, np.ndarray):
+            return bitops.popcount_host(seg)
+        return int(bitops.count_bits(seg))
 
     def count(self) -> int:
         """Python-int exact total (per-shard int32 partials summed host
         side, so >2^31 totals are safe)."""
-        return sum(
-            int(bitops.count_bits(seg)) for seg in self.segments.values()
-        )
+        return sum(self._seg_count(seg) for seg in self.segments.values())
 
     def intersection_count(self, other: "Row") -> int:
         total = 0
         for shard in set(self.segments) & set(other.segments):
-            total += int(
-                bitops.intersection_count(self.segments[shard], other.segments[shard])
-            )
+            a, b = self.segments[shard], other.segments[shard]
+            if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
+                total += bitops.pair_count_host(a, b, "intersect")
+            else:
+                total += int(bitops.intersection_count(a, b))
         return total
 
     def is_empty(self) -> bool:
-        return all(int(bitops.count_bits(s)) == 0 for s in self.segments.values())
+        return all(self._seg_count(s) == 0 for s in self.segments.values())
 
     def columns(self) -> np.ndarray:
         """Absolute sorted column ids (host materialization at the API

@@ -1,0 +1,81 @@
+"""Shared build-on-demand loader for the native C++ libraries.
+
+Both native components (the roaring codec, storage/_native.py, and the
+host latency-tier kernels, ops/_hostops.py) follow the same contract:
+the .so is compiled next to its source with g++ on first use (so
+``-march=native`` is always safe — the binary never leaves the machine
+that built it), staleness is judged by source mtime, every entry point
+degrades to a Python fallback when no toolchain exists, and
+``PILOSA_TPU_NO_NATIVE=1`` forces the fallback.  One loader owns that
+sequence so fixes (like the concurrent-build race below) cannot drift
+between copies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable
+
+
+def build(src: str, lib_path: str) -> bool:
+    """Compile ``src`` into ``lib_path`` atomically.
+
+    The object is written to a PER-PROCESS temp name and os.replace'd
+    in: two processes building concurrently (cluster nodes on one host,
+    parallel test workers) each produce a complete .so and the last
+    rename wins — a shared fixed temp name would interleave their
+    compiler output into a permanently corrupt library.
+    ``-march=native`` first (popcnt/AVX on x86); plain -O3 for
+    toolchains that reject it."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(lib_path) or ".", suffix=".so.tmp"
+    )
+    os.close(fd)
+    try:
+        for extra in (["-march=native"], []):
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", *extra,
+                src, "-o", tmp,
+            ]
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=120
+                )
+                os.replace(tmp, lib_path)
+                return True
+            except (OSError, subprocess.SubprocessError):
+                continue
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load(src: str, lib_path: str, bind: Callable[[ctypes.CDLL], None]):
+    """Load (building if missing/stale) and bind the library; None when
+    unavailable for any reason — toolchain absent, load failure, or a
+    stale prebuilt .so missing expected symbols (``bind`` raising
+    AttributeError).  Callers cache the result under their own lock."""
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(lib_path) or (
+        os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(lib_path)
+    ):
+        if not os.path.exists(src) or not build(src, lib_path):
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    try:
+        bind(lib)
+    except AttributeError:
+        return None
+    return lib

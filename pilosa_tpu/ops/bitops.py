@@ -75,9 +75,40 @@ def pack_positions(positions: np.ndarray, n_words: int) -> tuple[np.ndarray, np.
 
 
 def popcount_host(words: np.ndarray) -> int:
-    """Host popcount over a word array (any shape)."""
-    words = np.ascontiguousarray(words, dtype=np.uint32)
-    return int(np.unpackbits(words.view(np.uint8)).sum())
+    """Host popcount over a word array (any shape) — native single-pass
+    kernel (native/hostops.cpp), numpy ``bitwise_count`` fallback."""
+    from pilosa_tpu.ops import _hostops
+
+    return _hostops.popcount(words)
+
+
+def pair_count_host(a: np.ndarray, b: np.ndarray, op: str) -> int:
+    """Fused host ``popcount(op(a, b))`` with no materialized temporary
+    — the latency-tier twin of the jitted ``*_count`` kernels below
+    (reference roaring.go:568's word loop). ``op`` is one of
+    intersect/union/difference/xor."""
+    from pilosa_tpu.ops import _hostops
+
+    return _hostops.pair_count(a, b, op)
+
+
+def shift_row_host(words: np.ndarray, n: int = 1) -> np.ndarray:
+    """Host twin of :func:`shift_row`: shift bits toward higher column
+    ids, dropping bits past the shard edge."""
+    words = np.asarray(words, dtype=np.uint32)
+    nw = words.shape[-1]
+    n = int(n)
+    if n <= 0:
+        return words.copy()
+    word_shift, bit_shift = divmod(n, WORD_BITS)
+    out = np.zeros_like(words)
+    if word_shift < nw:
+        out[..., word_shift:] = words[..., : nw - word_shift]
+    if bit_shift:
+        carry = np.zeros_like(out)
+        carry[..., 1:] = out[..., :-1] >> np.uint32(WORD_BITS - bit_shift)
+        out = ((out << np.uint32(bit_shift)) | carry).astype(np.uint32)
+    return out
 
 
 # ---------------------------------------------------------------------------

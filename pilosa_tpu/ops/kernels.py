@@ -475,14 +475,15 @@ def gram_matrix_traced(bits: jax.Array) -> jax.Array:
 
 def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
     """The gram family's shared probe/demote contract: the first success
-    proves the gate; a failure BEFORE the gate is proven demotes it
-    permanently; past the probe, each failure is answered by
-    ``fallback_fn`` and counted visibly, and _PallasGate.MAX_FAILS
-    LIFETIME failures demote (never reset on success — a healthy
-    sibling program sharing the gate must not starve a broken one's
-    demotion) — balancing "one transient must not disable a proven
-    kernel" against "a persistently broken cached program must not pay
-    a failed launch per call forever"."""
+    proves the gate; every failure — probe-time or proven — is answered
+    by ``fallback_fn``, counted visibly, and charged against
+    _PallasGate.MAX_FAILS LIFETIME failures before demotion (never reset
+    on success — a healthy sibling program sharing the gate must not
+    starve a broken one's demotion).  Probe-time failures get the same
+    tolerance as proven-kernel failures: one device-OOM blip on the
+    first-ever call must not silently lose the fused path for the
+    process lifetime, while a genuinely broken kernel (compile error)
+    still demotes after MAX_FAILS bounded re-probes."""
     gate = gate or _self_gram_gate
     try:
         # always synchronize INSIDE the try: async dispatch would let a
@@ -494,21 +495,23 @@ def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
             gate.ok = True
         return out
     except Exception as exc:
-        if gate.ok is None:
+        probing = gate.ok is None
+        _note_pallas_fallback(exc)
+        gate.fails += 1
+        if gate.fails >= gate.MAX_FAILS:
             gate.ok = False
-            # a failed PROBE silently disables a default-ON fast path:
-            # log it once so the resulting latency is diagnosable
+        if probing:
+            # a failing PROBE degrades a default-ON fast path: log each
+            # attempt so the resulting latency is diagnosable
             import logging
 
             logging.getLogger("pilosa_tpu.kernels").warning(
-                "pallas gram probe failed; kernel family disabled: %r",
+                "pallas gram probe failed (%d/%d)%s: %r",
+                gate.fails,
+                gate.MAX_FAILS,
+                "; kernel family disabled" if gate.ok is False else "",
                 exc,
             )
-        else:
-            _note_pallas_fallback(exc)
-            gate.fails += 1
-            if gate.fails >= gate.MAX_FAILS:
-                gate.ok = False
         return fallback_fn()
 
 

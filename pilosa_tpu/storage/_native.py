@@ -1,20 +1,22 @@
 """ctypes bindings for the native C++ roaring codec (native/roaring_codec.cpp).
 
 The reference's storage hot loops are compiled Go; here they are C++
-behind a C ABI.  The shared library is built on demand with g++ (cached
-next to the source), and every entry point degrades to ``None`` so
-callers fall back to the vectorized-numpy codec when no toolchain exists.
-Set ``PILOSA_TPU_NO_NATIVE=1`` to force the Python path.
+behind a C ABI.  The shared library is built on demand through the
+shared loader (pilosa_tpu/nativelib.py), and every entry point degrades
+to ``None`` so callers fall back to the vectorized-numpy codec when no
+toolchain exists.  Set ``PILOSA_TPU_NO_NATIVE=1`` to force the Python
+path.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
+
+from pilosa_tpu import nativelib
 
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -29,25 +31,6 @@ _tried = False
 _has_fnv = False  # set at load(): the symbol is absent from older .so builds
 
 
-def _build() -> bool:
-    cmd = [
-        "g++",
-        "-O3",
-        "-std=c++17",
-        "-shared",
-        "-fPIC",
-        _SRC,
-        "-o",
-        _LIB_PATH + ".tmp",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
-
-
 def load() -> ctypes.CDLL | None:
     """The native library, building it on first use; None if unavailable."""
     global _lib, _tried
@@ -57,18 +40,11 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("PILOSA_TPU_NO_NATIVE"):
-            return None
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-        ):
-            if not os.path.exists(_SRC) or not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
+        _lib = nativelib.load(_SRC, _LIB_PATH, _bind)
+        return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
         lib.rt_serialize.restype = ctypes.c_int
         lib.rt_serialize.argtypes = [
             ctypes.POINTER(ctypes.c_uint64),
@@ -103,8 +79,6 @@ def load() -> ctypes.CDLL | None:
         ]
         lib.rt_free.restype = None
         lib.rt_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
 
 
 def serialize(positions: np.ndarray, flags: int = 0) -> bytes | None:

@@ -100,6 +100,21 @@ class FragmentFile:
             self.check_row(int(r))
         rows = rows.astype(np.uint64)
         masks = np.ascontiguousarray(masks, dtype=np.uint32)
+        # native ctz walk per row when available (one ctypes call per
+        # row, each a contiguous mask) — the numpy blockwise expansion
+        # below is the no-toolchain fallback
+        from pilosa_tpu.ops import _hostops
+
+        if _hostops.load() is not None:
+            parts = [
+                _hostops.extract_positions(
+                    masks[i], int(rows[i]) * width
+                )
+                for i in range(len(rows))
+            ]
+            if not parts:
+                return np.empty(0, dtype=np.uint64)
+            return np.concatenate(parts)
         sl, wi = np.nonzero(masks)
         if not len(sl):
             return np.empty(0, dtype=np.uint64)
@@ -125,12 +140,23 @@ class FragmentFile:
         return np.concatenate(parts)
 
     def _append(self, record: bytes, count: int) -> None:
+        self._append_many([record], count)
+
+    def _append_many(self, records: list[bytes], count: int) -> None:
+        """Append several records with ONE flush+fsync — a bulk batch is
+        durable as a unit (each record still carries its own checksum,
+        so a torn tail replays cleanly), and the reference's
+        WAL-amortized import pays one sync per bulk call too
+        (fragment.go:1995-2280)."""
+        if not records:
+            return
         with self._lock:
             if self._fh is None:
                 self._fh = open(self.path, "ab")
-            self._fh.write(record)
+            for record in records:
+                self._fh.write(record)
             self._fh.flush()
-            os.fsync(self._fh.fileno())  # durable against power loss
+            os.fsync(self._fh.fileno())
             self.op_n += count
             self.mut_seq += 1
         if self.op_n > MAX_OP_N:
@@ -167,9 +193,11 @@ class FragmentFile:
             self._emit_batch(roaring.OP_REMOVE_BATCH, np.concatenate(removes))
 
     def _emit_batch(self, op_type: int, positions: np.ndarray) -> None:
-        for i in range(0, len(positions), _BATCH_CHUNK):
-            chunk = positions[i : i + _BATCH_CHUNK]
-            self._append(roaring.encode_op(op_type, chunk), len(chunk))
+        records = [
+            roaring.encode_op(op_type, positions[i : i + _BATCH_CHUNK])
+            for i in range(0, len(positions), _BATCH_CHUNK)
+        ]
+        self._append_many(records, len(positions))
 
     def log_add(self, row: int, col: int) -> None:
         pos = self._pos(row, col)
@@ -208,6 +236,25 @@ class FragmentFile:
 
     def log_remove_masks(self, rows: np.ndarray, masks: np.ndarray) -> None:
         positions = self._positions_multi(rows, masks)
+        if self._batch_depth:
+            self._batch_remove.append(positions)
+            return
+        self._emit_batch(roaring.OP_REMOVE_BATCH, positions)
+
+    def log_add_positions(self, positions: np.ndarray) -> None:
+        """Bulk-add op records from PRE-COMPUTED absolute positions —
+        the sustained-ingest hot path (Fragment.import_bits derives the
+        changed positions as a by-product of its merge, so no mask
+        unpack happens here; reference roaring.go:1463's rowSet change
+        tracking plays the same role).  Caller has check_row'd the rows."""
+        positions = np.ascontiguousarray(positions, dtype=np.uint64)
+        if self._batch_depth:
+            self._batch_add.append(positions)
+            return
+        self._emit_batch(roaring.OP_ADD_BATCH, positions)
+
+    def log_remove_positions(self, positions: np.ndarray) -> None:
+        positions = np.ascontiguousarray(positions, dtype=np.uint64)
         if self._batch_depth:
             self._batch_remove.append(positions)
             return

@@ -227,6 +227,43 @@ def test_distributed_topn_and_bsi(cluster3):
     assert sorted(rng["columns"]) == [SHARD_WIDTH, 2 * SHARD_WIDTH + 7]
 
 
+def test_distributed_topn_second_pass_exactness(cluster3):
+    """A row that is NOT any single node's #1 but IS the global #1 must
+    win: per-node truncation alone would return the wrong row (and
+    wrong counts), so this asserts the candidate-union refetch
+    (reference executor.go:884-999 second phase).
+
+    Layout: shard A (node X) has row 1 x4 bits, row 9 x3; shard B
+    (node Y, a different node) has row 9 x3, row 2 x1.  Phase-1 top-1
+    lists are [(1,4)] and [(9,3)] — a naive merge picks row 1 with
+    count 4, but the true global top is row 9 with count 6."""
+    cluster3.create_index("ci_topn2")
+    cluster3.create_field("ci_topn2", "f")
+    owner0 = cluster3.owner_of("ci_topn2", 0)
+    shard_b = next(
+        s
+        for s in range(1, 64)
+        if cluster3.owner_of("ci_topn2", s) is not owner0
+    )
+    bits = []
+    bits += [(1, c) for c in range(4)]  # shard A: row 1 x4
+    bits += [(9, 100 + c) for c in range(3)]  # shard A: row 9 x3
+    base = shard_b * SHARD_WIDTH
+    bits += [(9, base + c) for c in range(3)]  # shard B: row 9 x3
+    bits += [(2, base + 100)]  # shard B: row 2 x1
+    cluster3.import_bits("ci_topn2", "f", bits)
+    pairs = cluster3.query(0, "ci_topn2", "TopN(f, n=1)")["results"][0]
+    assert [(p["id"], p["count"]) for p in pairs] == [(9, 6)]
+    pairs = cluster3.query(1, "ci_topn2", "TopN(f, n=2)")["results"][0]
+    assert [(p["id"], p["count"]) for p in pairs] == [(9, 6), (1, 4)]
+    # every node agrees (any node can coordinate the two-phase query)
+    for i in range(3):
+        pairs = cluster3.query(i, "ci_topn2", "TopN(f, n=3)")["results"][0]
+        assert [(p["id"], p["count"]) for p in pairs] == [
+            (9, 6), (1, 4), (2, 1),
+        ]
+
+
 def test_distributed_groupby_and_rows(cluster3):
     cluster3.create_index("ci5")
     cluster3.create_field("ci5", "a")

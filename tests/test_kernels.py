@@ -469,15 +469,36 @@ class TestGramGatePolicy:
     def _gate(self):
         return kernels._PallasGate()
 
-    def test_probe_failure_demotes_and_answers(self):
+    def test_probe_failure_tolerated_then_demotes(self):
+        """A transient failure on the first-ever call must NOT demote
+        permanently (it gets the same MAX_FAILS tolerance as a proven
+        kernel); a persistently failing probe demotes after the bounded
+        re-probes."""
         gate = self._gate()
 
         def boom():
             raise RuntimeError("mosaic says no")
 
+        for i in range(gate.MAX_FAILS - 1):
+            out = kernels._with_gram_fallback(boom, lambda: "xla", gate=gate)
+            assert out == "xla"
+            assert gate.ok is None  # still unproven, not demoted
         out = kernels._with_gram_fallback(boom, lambda: "xla", gate=gate)
         assert out == "xla"
-        assert gate.ok is False
+        assert gate.ok is False  # bounded re-probes exhausted
+
+    def test_probe_transient_then_success_proves_gate(self):
+        gate = self._gate()
+
+        def boom():
+            raise RuntimeError("transient OOM at startup")
+
+        assert kernels._with_gram_fallback(boom, lambda: "x", gate=gate) == "x"
+        assert gate.ok is None
+        out = kernels._with_gram_fallback(
+            lambda: jnp.zeros(()), lambda: "x", gate=gate
+        )
+        assert out is not None and gate.ok is True
 
     def test_established_gate_survives_transients_then_demotes(self):
         gate = self._gate()
@@ -503,6 +524,8 @@ class TestGramGatePolicy:
         def boom():
             raise RuntimeError("no")
 
-        kernels._with_gram_fallback(boom, lambda: "x", gate=g1)
+        for _ in range(g1.MAX_FAILS):
+            kernels._with_gram_fallback(boom, lambda: "x", gate=g1)
         assert g1.ok is False
-        assert g2.ok is None  # one kernel's probe never condemns another
+        assert g2.ok is None and g2.fails == 0  # one kernel's probe
+        # never condemns another
