@@ -113,10 +113,26 @@ def cmd_server(args) -> int:
     if hbm is not None:
         membudget.configure(hbm or None)
 
-    # metric.service selects the backend (reference server.go:397-411);
-    # "none" keeps the zero-cost nop client.
+    # metric.service selects the backend (reference server.go:397-411):
+    # none | expvar/prometheus (in-memory, served at /metrics and
+    # /debug/vars) | statsd/datadog (UDP push, reference
+    # statsd/statsd.go:48).
     metric_cfg = cfg.get("metric", {})
-    stats_client = NOP if metric_cfg.get("service", "none") == "none" else MemStatsClient()
+    service = metric_cfg.get("service", "none")
+    if service == "none":
+        stats_client = NOP
+    elif service in ("statsd", "datadog"):
+        from pilosa_tpu.obs.stats import StatsDClient
+
+        raw = metric_cfg.get("host", "127.0.0.1:8125")
+        mhost, _, mport = raw.rpartition(":")
+        if not mhost or not mport.isdigit():
+            # portless host ("statsd.local") or IPv6 literal: treat the
+            # whole value as the host, default the port
+            mhost, mport = raw, "8125"
+        stats_client = StatsDClient(mhost or "127.0.0.1", int(mport))
+    else:  # expvar / prometheus: in-memory client served over HTTP
+        stats_client = MemStatsClient()
     tls_cfg = cfg.get("tls", {})
     node = NodeServer(
         data_dir=data_dir,
@@ -268,6 +284,150 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def _cluster_hosts(args) -> tuple[list[str], str]:
+    """([host:port of every live node], primary's host:port) — backup
+    must see EVERY node's fragments and the translation PRIMARY's log
+    (a replica's copy can lag by one anti-entropy interval)."""
+    try:
+        nodes = json.loads(_http(args, "GET", "/internal/nodes"))
+    except Exception:
+        return [args.host], args.host
+    hosts, primary = [], args.host
+    for n in nodes:
+        uri = n.get("uri", "")
+        host = uri.split("://", 1)[-1] if uri else ""
+        if not host:
+            continue
+        hosts.append(host)
+        if n.get("isCoordinator"):
+            primary = host
+    return hosts or [args.host], primary
+
+
+def cmd_backup(args) -> int:
+    """Online backup of a running node/cluster into one tar (reference
+    fragment.go:2424-2594's tar fragment format, operator-facing like
+    ctl backup): schema.json + translate.json + every fragment as a
+    roaring blob at fragments/<index>/<field>/<view>/<shard>.roaring.
+    The fragment inventory is the union over EVERY cluster node (each
+    node reports only its local fragments) and each blob is fetched
+    from a node that holds it; the translation feed comes from the
+    primary.  Row/column attributes are not included."""
+    import argparse as _argparse
+    import io
+    import tarfile
+
+    def add(tar, name: str, data: bytes) -> None:
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+
+    hosts, primary_host = _cluster_hosts(args)
+    schema = _http(args, "GET", "/schema")
+    # union inventory; remember one holder per fragment
+    holder_of: dict[tuple, str] = {}
+    for host in hosts:
+        hargs = _argparse.Namespace(host=host)
+        inv = json.loads(_http(hargs, "GET", "/internal/fragments"))[
+            "fragments"
+        ]
+        for f in inv:
+            if args.index and f["index"] != args.index:
+                continue
+            holder_of.setdefault(
+                (f["index"], f["field"], f["view"], f["shard"]), host
+            )
+    # full translation feed from the PRIMARY (pull in pages)
+    pargs = _argparse.Namespace(host=primary_host)
+    entries, offset = [], 0
+    while True:
+        page = json.loads(
+            _http(pargs, "GET", f"/internal/translate/log?offset={offset}")
+        )
+        entries.extend(page["entries"])
+        if page["offset"] == offset:
+            break
+        offset = page["offset"]
+    if args.index:
+        # column keys live under the index name; row keys under the
+        # same index with a field name — both carry entry[0] == index
+        entries = [e for e in entries if e[0] == args.index]
+    out = sys.stdout.buffer if args.output == "-" else open(args.output, "wb")
+    with tarfile.open(fileobj=out, mode="w|") as tar:
+        add(tar, "schema.json", schema)
+        add(tar, "translate.json", json.dumps({"entries": entries}).encode())
+        for (index, field, view, shard), host in sorted(holder_of.items()):
+            blob = _http(
+                _argparse.Namespace(host=host),
+                "GET",
+                f"/internal/fragment/data?index={index}&field={field}"
+                f"&view={view}&shard={shard}",
+            )
+            add(
+                tar,
+                f"fragments/{index}/{field}/{view}/{shard}.roaring",
+                blob,
+            )
+    if out is not sys.stdout.buffer:
+        out.close()
+    print(
+        f"backed up {len(holder_of)} fragments, {len(entries)} key mappings",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Restore a backup tar into a running node/cluster: apply schema,
+    install key translations, then import-roaring every fragment (the
+    import path routes each shard to its owners, so restoring into a
+    different cluster shape re-places the data)."""
+    import tarfile
+
+    src = sys.stdin.buffer if args.file == "-" else open(args.file, "rb")
+    n_frags = 0
+    with tarfile.open(fileobj=src, mode="r|*") as tar:
+        for member in tar:
+            f = tar.extractfile(member)
+            if f is None:
+                continue
+            data = f.read()
+            if member.name == "schema.json":
+                # /schema applies locally (the resize path uses it
+                # per-node), so install it on EVERY node before any
+                # fragment import forwards to a replica
+                import argparse as _argparse
+
+                hosts, _ = _cluster_hosts(args)
+                for host in hosts:
+                    _http(
+                        _argparse.Namespace(host=host),
+                        "POST",
+                        "/schema",
+                        data,
+                    )
+            elif member.name == "translate.json":
+                _http(
+                    args, "POST", "/internal/translate/restore", data
+                )
+            elif member.name.startswith("fragments/"):
+                _, index, field, view, fname = member.name.split("/")
+                shard = int(fname.removesuffix(".roaring"))
+                _http(
+                    args,
+                    "POST",
+                    f"/index/{index}/field/{field}/import-roaring/{shard}"
+                    f"?view={view}",
+                    data,
+                    content_type="application/octet-stream",
+                )
+                n_frags += 1
+    if src is not sys.stdin.buffer:
+        src.close()
+    print(f"restored {n_frags} fragments", file=sys.stderr)
+    return 0
+
+
 def cmd_generate_config(args) -> int:
     print(json.dumps(DEFAULT_CONFIG, indent=2))
     return 0
@@ -322,6 +482,17 @@ def main(argv=None) -> int:
     pe.add_argument("-f", "--field", required=True)
     pe.add_argument("-o", "--output", default="-")
     pe.set_defaults(fn=cmd_export)
+
+    pb = sub.add_parser("backup", help="backup a running cluster to a tar")
+    pb.add_argument("--host", default="localhost:10101")
+    pb.add_argument("-o", "--output", default="-")
+    pb.add_argument("-i", "--index", default=None, help="only this index")
+    pb.set_defaults(fn=cmd_backup)
+
+    pr = sub.add_parser("restore", help="restore a backup tar into a cluster")
+    pr.add_argument("--host", default="localhost:10101")
+    pr.add_argument("file", help="backup tar path, or - for stdin")
+    pr.set_defaults(fn=cmd_restore)
 
     pc = sub.add_parser("check", help="verify fragment files")
     pc.add_argument("files", nargs="+")

@@ -44,12 +44,27 @@ class HolderSyncer:
         (reference SyncHolder holder.go:683)."""
         stats = {
             "fragments": 0, "blocks_diff": 0, "bits_set": 0,
-            "bits_cleared": 0, "attrs_merged": 0,
+            "bits_cleared": 0, "attrs_merged": 0, "translate_entries": 0,
         }
         if len(self.cluster.nodes) <= 1:
             return stats
         # span per pass (reference holder.go:683 SyncHolder spans)
         with tracing.start_span("holderSyncer.SyncHolder"):
+            # translate-log replication rides the anti-entropy carrier
+            # (reference replicas stream continuously, translate.go:91-97;
+            # one pull per pass converges replicas the same way)
+            translator = (
+                self.api.executor.translator if self.api is not None else None
+            )
+            if translator is not None and hasattr(
+                translator, "sync_from_primary"
+            ):
+                try:
+                    stats["translate_entries"] = translator.sync_from_primary()
+                except Exception:
+                    logger.warning(
+                        "translate-log sync failed", exc_info=True
+                    )
             self.sync_schema()
             for index_name in list(self.holder.index_names()):
                 idx = self.holder.index(index_name)

@@ -265,6 +265,24 @@ class InternalClient:
             {"index": index, "field": field, "keys": keys},
         )["ids"]
 
+    def translate_log(
+        self, uri: str, offset: int
+    ) -> tuple[list[tuple[str, str, str, int]], int, int]:
+        """(entries, new_offset, primary_log_len) since ``offset`` — the
+        replica streaming pull (reference translate.go:91-97)."""
+        out = self._json(
+            "GET", uri, f"/internal/translate/log?offset={int(offset)}", None
+        )
+        entries = [
+            (e[0], e[1], e[2], int(e[3])) for e in out.get("entries", [])
+        ]
+        return entries, int(out.get("offset", offset)), int(out.get("len", 0))
+
+    def translate_restore(self, uri: str, entries: list) -> dict:
+        return self._json(
+            "POST", uri, "/internal/translate/restore", {"entries": entries}
+        )
+
     def translate_ids(
         self, uri: str, index: str, field: str | None, ids: list[int]
     ) -> list[str]:
@@ -323,3 +341,9 @@ class NopInternalClient:
 
     def translate_ids(self, uri, index, field, ids):
         return []
+
+    def translate_log(self, uri, offset):
+        return [], offset, 0
+
+    def translate_restore(self, uri, entries):
+        return {"restored": 0}

@@ -22,6 +22,9 @@ class PrimaryTranslateStore:
         self.local = local
         self.cluster = cluster
         self.client = client
+        # replication cursor into the primary's entry log (reference
+        # translate.go:91-97 log-position streaming)
+        self._log_offset = 0
 
     def _is_primary(self) -> bool:
         primary = self.cluster.translate_primary()
@@ -53,6 +56,50 @@ class PrimaryTranslateStore:
         # rather than cached as poison.
         self.local.set_mapping(index, field, keys, id_list)
         return keys
+
+    def sync_from_primary(self) -> int:
+        """Pull the primary's entry log since our cursor and apply it
+        locally; returns the number of entries applied (the reference's
+        replica log streaming, translate.go:91-97; carried here by the
+        anti-entropy loop).  After a full sync every ids->keys read is
+        local, the local ``.keys`` log holds a complete copy (set_mapping
+        fires on_insert for each new entry), and this node can take over
+        as primary with full state.  A restarted primary re-feeds its
+        log from a possibly different offset base, so the cursor resets
+        whenever it runs past the primary's log length."""
+        if self._is_primary():
+            return 0
+        primary = self.cluster.translate_primary()
+        applied = 0
+        while True:
+            entries, new_offset, log_len = self.client.translate_log(
+                primary.uri, self._log_offset
+            )
+            if self._log_offset > log_len:
+                # primary restarted with a shorter log: restart the feed
+                # (applies are idempotent)
+                self._log_offset = 0
+                continue
+            if not entries:
+                return applied
+            # batch contiguous (index, field) runs — one set_mapping
+            # (and one on_insert disk append) per run, not per key,
+            # matching the replay path's batching (translatelog.py)
+            run: tuple[str, str] | None = None
+            keys: list[str] = []
+            ids: list[int] = []
+            for index, field, key, id_ in entries:
+                if (index, field) != run:
+                    if run is not None:
+                        self.local.set_mapping(run[0], run[1], keys, ids)
+                    run = (index, field)
+                    keys, ids = [], []
+                keys.append(key)
+                ids.append(id_)
+            if run is not None:
+                self.local.set_mapping(run[0], run[1], keys, ids)
+            applied += len(entries)
+            self._log_offset = new_offset
 
     def translate_key(self, index: str, field: str, key: str, create: bool = True) -> int:
         return self.translate_keys(index, field, [key], create=create)[0]

@@ -33,6 +33,11 @@ class TranslateStore:
         # storage layer appends these to the on-disk log (reference
         # translate.go:37-40 InsertColumn/InsertRow entries).
         self.on_insert = None  # fn(index, field, key, id)
+        # Ordered in-memory entry log: every new mapping, in apply
+        # order.  Replicas stream it by offset (the role of the
+        # reference's log-position replication, translate.go:91-97);
+        # disk replay rebuilds it in original append order.
+        self.log: list[tuple[str, str, str, int]] = []
 
     def _space(self, index: str, field: str):
         ids = self._ids.setdefault((index, field), {})
@@ -58,6 +63,7 @@ class TranslateStore:
                     id_ = len(key_list) + 1
                     ids[k] = id_
                     key_list.append(k)
+                    self.log.append((index, field, k, id_))
                     if self.on_insert is not None:
                         self.on_insert(index, field, k, id_)
                 out.append(id_)
@@ -92,8 +98,25 @@ class TranslateStore:
                 changed = key_list[i - 1] != k
                 key_list[i - 1] = k
                 ids[k] = i
-                if changed and self.on_insert is not None:
-                    self.on_insert(index, field, k, i)
+                if changed:
+                    self.log.append((index, field, k, i))
+                    if self.on_insert is not None:
+                        self.on_insert(index, field, k, i)
+
+    def log_entries(
+        self, offset: int, limit: int = 50_000
+    ) -> tuple[list[tuple[str, str, str, int]], int]:
+        """(entries since ``offset``, new offset) — the replication feed
+        a replica pulls to mirror this store (reference translate.go
+        :91-97 log streaming).  Bounded by ``limit`` per pull so one
+        request never ships an unbounded log."""
+        with self._lock:
+            chunk = self.log[offset : offset + limit]
+            return chunk, offset + len(chunk)
+
+    def log_len(self) -> int:
+        with self._lock:
+            return len(self.log)
 
     # -- persistence --------------------------------------------------------
 
@@ -107,9 +130,17 @@ class TranslateStore:
         with self._lock:
             self._ids.clear()
             self._keys.clear()
+            self.log = []
             for joined, key_list in d.items():
                 index, _, field = joined.partition("|")
                 self._keys[(index, field)] = list(key_list)
                 self._ids[(index, field)] = {
                     k: i + 1 for i, k in enumerate(key_list)
                 }
+                # synthetic (id-ordered per space) log: a snapshot has no
+                # append order, but the feed must still be complete
+                self.log.extend(
+                    (index, field, k, i + 1)
+                    for i, k in enumerate(key_list)
+                    if k
+                )

@@ -180,6 +180,80 @@ class MemStatsClient(StatsClient):
             }
 
 
+class StatsDClient(StatsClient):
+    """UDP statsd/DataDog backend (reference statsd/statsd.go:48 — the
+    DataDog dogstatsd client with tag support, selected by
+    ``metric.service = "statsd"``/``"datadog"``).
+
+    Wire format per datagram: ``pilosa.<name>:<value>|<type>[|@rate][|#tags]``
+    — counters ``c``, gauges ``g``, histograms/timings ``h``/``ms``,
+    sets ``s``.  Fire-and-forget: send failures are swallowed (a
+    metrics sink must never take the server down), matching the
+    reference client's behavior."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8125,
+        prefix: str = "pilosa.",
+        tags: tuple[str, ...] = (),
+    ):
+        import socket
+
+        self._addr = (host, port)
+        self._prefix = prefix
+        self._tags = tuple(tags)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+
+    def with_tags(self, *tags: str) -> "StatsDClient":
+        child = object.__new__(StatsDClient)
+        child._addr = self._addr
+        child._prefix = self._prefix
+        child._sock = self._sock
+        child._tags = self._tags + tuple(tags)
+        return child
+
+    def _send(
+        self, name: str, value, typ: str, rate: float = 1.0,
+        tags: Iterable[str] = (),
+    ) -> None:
+        msg = f"{self._prefix}{name}:{value}|{typ}"
+        if rate != 1.0:
+            msg += f"|@{rate}"
+        all_tags = self._tags + tuple(tags)
+        if all_tags:
+            msg += "|#" + ",".join(all_tags)
+        try:
+            self._sock.sendto(msg.encode(), self._addr)
+        except OSError:
+            pass  # fire-and-forget
+
+    def count(self, name, value=1, rate=1.0):
+        self._send(name, value, "c", rate)
+
+    def count_with_tags(self, name, value, rate, tags):
+        self._send(name, value, "c", rate, tags)
+
+    def gauge(self, name, value):
+        self._send(name, value, "g")
+
+    def histogram(self, name, value):
+        self._send(name, value, "h")
+
+    def set_value(self, name, value):
+        self._send(name, value, "s")
+
+    def timing(self, name, seconds):
+        self._send(name, round(seconds * 1e3, 3), "ms")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def _prom_name(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 

@@ -874,6 +874,13 @@ class API:
         elif t == bc.MSG_NODE_STATE:
             if self.cluster is not None and hasattr(self.cluster, "mark_node_state"):
                 self.cluster.mark_node_state(msg["node"], msg["state"])
+        elif t == bc.MSG_SET_COORDINATOR:
+            # coordinator (= translation primary) moved (reference
+            # SetCoordinatorMessage handling, server.go:549-643)
+            if self.cluster is not None and msg.get("coordinator"):
+                self.cluster.coordinator_id = msg["coordinator"]
+                for n in self.cluster.nodes:
+                    n.is_coordinator = n.id == msg["coordinator"]
         elif t == bc.MSG_RECALCULATE_CACHES:
             pass  # device row counts are exact; no cache to rebuild
         return {}
@@ -885,6 +892,73 @@ class API:
     def translate_ids(self, index: str, field: str | None, ids: list[int]) -> list[str]:
         self._validate("TranslateKeys")
         return self.executor.translator.translate_ids(index, field or "", ids)
+
+    def translate_log(self, offset: int) -> dict:
+        """Entry-log feed for replica streaming (reference
+        translate.go:91-97): entries since ``offset`` from the LOCAL
+        store plus its total length (replicas detect a restarted/
+        shorter primary log by the length)."""
+        self._validate("TranslateKeys")
+        translator = self.executor.translator
+        local = getattr(translator, "local", translator)
+        entries, new_offset = local.log_entries(int(offset))
+        return {
+            "entries": [list(e) for e in entries],
+            "offset": new_offset,
+            "len": local.log_len(),
+        }
+
+    def translate_restore(self, entries: list) -> dict:
+        """Install exact (index, field, key, id) mappings — the restore
+        half of backup's translation dump (set_mapping bypasses
+        read-only, the same path replica streaming uses).  In cluster
+        mode the restore is FORWARDED to the translation primary: only
+        its store allocates future ids, so installing on a replica
+        alone would let the primary re-allocate colliding ids; replicas
+        then converge via log streaming."""
+        self._validate("TranslateKeys")
+        translator = self.executor.translator
+        if (
+            self.cluster is not None
+            and self.client is not None
+            and hasattr(translator, "_is_primary")
+            and not translator._is_primary()
+        ):
+            primary = self.cluster.translate_primary()
+            return self.client.translate_restore(primary.uri, entries)
+        local = getattr(translator, "local", translator)
+        for index, field, key, id_ in entries:
+            local.set_mapping(index, field, [key], [int(id_)])
+        return {"restored": len(entries)}
+
+    def set_coordinator(self, node_id: str) -> dict:
+        """Move the coordinator (and with it the translation-primary
+        role) to ``node_id``, broadcasting so every live node converges
+        (reference api.go:1192-1256 SetCoordinator + the
+        SetCoordinatorMessage broadcast).  Used for takeover after a
+        dead coordinator: any surviving node accepts this call."""
+        if self.cluster is None:
+            raise ApiError("cluster not configured", 400)
+        if self.cluster.node(node_id) is None:
+            raise ApiError(f"unknown node: {node_id}", 400)
+        import pilosa_tpu.cluster.broadcast as bc
+
+        self.cluster.coordinator_id = node_id
+        for n in self.cluster.nodes:
+            n.is_coordinator = n.id == node_id
+        if self.broadcaster is not None:
+            try:
+                self.broadcaster.send_sync(
+                    {"type": bc.MSG_SET_COORDINATOR, "coordinator": node_id}
+                )
+            except Exception:
+                # best-effort: takeover typically runs BECAUSE a node is
+                # dead; survivors converged, the dead node re-learns the
+                # coordinator from ClusterStatus on rejoin
+                logger.warning(
+                    "set-coordinator broadcast incomplete", exc_info=True
+                )
+        return {"coordinator": node_id}
 
     def _node_id(self) -> str:
         if self.store is not None:

@@ -62,6 +62,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/shards/max$"), "shards_max"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
     ("POST", re.compile(r"^/internal/translate/ids$"), "translate_ids"),
+    ("GET", re.compile(r"^/internal/translate/log$"), "translate_log"),
+    ("POST", re.compile(r"^/internal/translate/restore$"), "translate_restore"),
+    ("POST", re.compile(r"^/cluster/resize/set-coordinator$"), "set_coordinator"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "cluster_message"),
     ("GET", re.compile(r"^/internal/attr/blocks$"), "attr_blocks"),
     ("POST", re.compile(r"^/internal/attr/block/data$"), "attr_block_data"),
@@ -390,6 +393,21 @@ class Handler(BaseHTTPRequestHandler):
             body.get("index", ""), body.get("field", ""), body.get("ids", [])
         )
         self._send_json(200, {"keys": keys})
+
+    def r_translate_log(self):
+        qs = parse_qs(urlparse(self.path).query)
+        offset = int(qs.get("offset", ["0"])[0])
+        self._send_json(200, self.api.translate_log(offset))
+
+    def r_translate_restore(self):
+        body = self._json_body()
+        self._send_json(
+            200, self.api.translate_restore(body.get("entries", []))
+        )
+
+    def r_set_coordinator(self):
+        body = self._json_body()
+        self._send_json(200, self.api.set_coordinator(body.get("id", "")))
 
 
 class Server:

@@ -7,9 +7,13 @@ Layout under a data directory:
     <data>/.id                          node id (reference holder.go:599-619)
     <data>/.keys.json                   key translation store
     <data>/<index>/.meta.json           index options
-    <data>/<index>/.attrs.json          column attrs
+    <data>/<index>/.attrs/b<block>.json column attrs, one file per 100-id
+                                        block (reference boltdb buckets,
+                                        boltdb/attrstore.go:37-90; a
+                                        legacy whole-store .attrs.json
+                                        migrates on first open)
     <data>/<index>/<field>/.meta.json   field options (+ bit depth/base)
-    <data>/<index>/<field>/.attrs.json  row attrs
+    <data>/<index>/<field>/.attrs/      row attrs, same block layout
     <data>/<index>/<field>/views/<view>/fragments/<shard>   roaring file
 
 Fragments attach ``FragmentFile`` stores as they are created, so every
@@ -29,6 +33,73 @@ from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.storage.fragmentfile import FragmentFile, SnapshotQueue
 from pilosa_tpu.storage.translatelog import TranslateLog
+
+
+class AttrBlocksDir:
+    """Per-block attr persistence backend: one ``b<block>.json`` per
+    100-id block under a directory, so a flush touches only the blocks
+    that changed and reads load lazily (the BoltDB+LRU role,
+    reference boltdb/attrstore.go:37-90)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _file(self, bid: int) -> str:
+        return os.path.join(self.path, f"b{bid}.json")
+
+    def load_block(self, bid: int) -> dict | None:
+        try:
+            with open(self._file(bid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def block_ids(self) -> list[int]:
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("b") and n.endswith(".json"):
+                try:
+                    out.append(int(n[1:-5]))
+                except ValueError:
+                    continue
+        return out
+
+    def write_blocks(self, blocks: dict[int, dict]) -> None:
+        """Write (or remove, when empty) exactly the given blocks;
+        tmp+rename per file so a crash never leaves a torn block."""
+        if not blocks:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        for bid, data in blocks.items():
+            path = self._file(bid)
+            if not data:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({str(k): v for k, v in data.items()}, f)
+            os.replace(tmp, path)
+
+
+def _attach_attr_backend(store, dir_path: str, legacy_json: str) -> None:
+    """Wire an AttrStore to its block dir, migrating a legacy
+    whole-store .attrs.json once."""
+    store.backend = AttrBlocksDir(dir_path)
+    if os.path.exists(legacy_json):
+        try:
+            with open(legacy_json) as f:
+                store.load_dict(json.load(f))
+            store.backend.write_blocks(store.drain_dirty())
+            os.unlink(legacy_json)
+        except (OSError, ValueError):
+            pass
 
 
 class HolderStore:
@@ -149,10 +220,11 @@ class HolderStore:
                 keys=meta.get("keys", False),
                 track_existence=meta.get("trackExistence", True),
             )
-            attrs_path = os.path.join(index_dir, ".attrs.json")
-            if os.path.exists(attrs_path):
-                with open(attrs_path) as f:
-                    idx.column_attrs.load_dict(json.load(f))
+            _attach_attr_backend(
+                idx.column_attrs,
+                os.path.join(index_dir, ".attrs"),
+                os.path.join(index_dir, ".attrs.json"),
+            )
             for field_name in sorted(os.listdir(index_dir)):
                 field_dir = self._field_dir(index_name, field_name)
                 fmeta_path = os.path.join(field_dir, ".meta.json")
@@ -168,10 +240,11 @@ class HolderStore:
                     )
                 field.base = fmeta.get("base", field.base)
                 field.bit_depth = fmeta.get("bitDepth", field.bit_depth)
-                fattrs_path = os.path.join(field_dir, ".attrs.json")
-                if os.path.exists(fattrs_path):
-                    with open(fattrs_path) as f:
-                        field.row_attrs.load_dict(json.load(f))
+                _attach_attr_backend(
+                    field.row_attrs,
+                    os.path.join(field_dir, ".attrs"),
+                    os.path.join(field_dir, ".attrs.json"),
+                )
                 views_dir = os.path.join(field_dir, "views")
                 if os.path.isdir(views_dir):
                     for view_name in sorted(os.listdir(views_dir)):
@@ -201,8 +274,9 @@ class HolderStore:
                 json.dump(
                     {"keys": idx.keys, "trackExistence": idx.track_existence}, f
                 )
-            with open(os.path.join(index_dir, ".attrs.json"), "w") as f:
-                json.dump(idx.column_attrs.to_dict(), f)
+            self._flush_attrs(
+                idx.column_attrs, os.path.join(index_dir, ".attrs")
+            )
             for field in idx.fields.values():
                 field_dir = self._field_dir(idx.name, field.name)
                 os.makedirs(field_dir, exist_ok=True)
@@ -215,8 +289,17 @@ class HolderStore:
                         },
                         f,
                     )
-                with open(os.path.join(field_dir, ".attrs.json"), "w") as f:
-                    json.dump(field.row_attrs.to_dict(), f)
+                self._flush_attrs(
+                    field.row_attrs, os.path.join(field_dir, ".attrs")
+                )
+
+    @staticmethod
+    def _flush_attrs(store, dir_path: str) -> None:
+        """Write only the blocks dirtied since the last flush (no
+        whole-store rewrite — reference boltdb writes per bucket)."""
+        if store.backend is None:
+            store.backend = AttrBlocksDir(dir_path)
+        store.backend.write_blocks(store.drain_dirty())
 
     def _detach_stores(self, match) -> None:
         """Close + drop FragmentFile stores whose fragment matches, so

@@ -55,8 +55,21 @@ class TranslateLog:
             return
         pos = _HDR.size
         good = pos
-        # batch per (index, field) for set_mapping efficiency
-        pending: dict[tuple[str, str], tuple[list, list]] = {}
+        # Batch CONTIGUOUS same-(index, field) runs for set_mapping
+        # efficiency while preserving the global record order — the
+        # rebuilt in-memory entry log must match the original append
+        # order so replica stream offsets stay meaningful across a
+        # primary restart.
+        run_space: tuple[str, str] | None = None
+        run_keys: list[str] = []
+        run_ids: list[int] = []
+
+        def flush_run():
+            if run_space is not None and run_keys:
+                self.store.set_mapping(
+                    run_space[0], run_space[1], run_keys, run_ids
+                )
+
         while pos + _REC.size <= len(data):
             typ, ilen, flen, klen, id_ = _REC.unpack_from(data, pos)
             end = pos + _REC.size + ilen + flen + klen
@@ -66,12 +79,14 @@ class TranslateLog:
             index = data[p : p + ilen].decode()
             field = data[p + ilen : p + ilen + flen].decode()
             key = data[p + ilen + flen : end].decode()
-            keys, ids = pending.setdefault((index, field), ([], []))
-            keys.append(key)
-            ids.append(id_)
+            if (index, field) != run_space:
+                flush_run()
+                run_space = (index, field)
+                run_keys, run_ids = [], []
+            run_keys.append(key)
+            run_ids.append(id_)
             pos = good = end
-        for (index, field), (keys, ids) in pending.items():
-            self.store.set_mapping(index, field, keys, ids)
+        flush_run()
         if good < len(data):
             # torn tail: truncate so future appends start at a record edge
             with open(self.path, "r+b") as f:

@@ -1,0 +1,163 @@
+"""Backup/restore CLI round trip (reference fragment.go:2424-2594 tar
+WriteTo/ReadFrom as an operator-facing backup) and the statsd stats
+backend (reference statsd/statsd.go:48)."""
+
+import argparse
+import json
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu.server.node import NodeServer
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class TestStatsD:
+    def test_wire_format_and_tags(self):
+        from pilosa_tpu.obs.stats import StatsDClient
+
+        sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sink.bind(("127.0.0.1", 0))
+        sink.settimeout(2)
+        port = sink.getsockname()[1]
+        c = StatsDClient("127.0.0.1", port, tags=("host:n1",))
+        c.count("set_bit", 2, rate=0.5)
+        c.gauge("goroutines", 7)
+        c.timing("query", 0.0125)
+        c.with_tags("index:i").count_with_tags(
+            "query_total", 1, 1.0, ("call:Count",)
+        )
+        got = sorted(sink.recv(512).decode() for _ in range(4))
+        assert got == sorted(
+            [
+                "pilosa.set_bit:2|c|@0.5|#host:n1",
+                "pilosa.goroutines:7|g|#host:n1",
+                "pilosa.query:12.5|ms|#host:n1",
+                "pilosa.query_total:1|c|#host:n1,index:i,call:Count",
+            ]
+        )
+        c.close()
+        sink.close()
+
+    def test_send_failure_swallowed(self):
+        from pilosa_tpu.obs.stats import StatsDClient
+
+        c = StatsDClient("127.0.0.1", 9)  # discard port, nothing listens
+        for _ in range(100):
+            c.count("x")  # must never raise even if buffers fill
+        c.close()
+
+
+class TestBackupRestore:
+    def _args(self, node, **kw):
+        host = node.uri.removeprefix("http://")
+        return argparse.Namespace(host=host, **kw)
+
+    def test_cluster_backup_is_cluster_wide(self, tmp_path):
+        """Backup taken through ONE node must capture fragments held by
+        every node and the PRIMARY's translation log; restore through a
+        NON-primary node must still land translations on the primary
+        (no id collisions afterwards)."""
+        from pilosa_tpu.cli import cmd_backup, cmd_restore
+        from pilosa_tpu.testing import InProcessCluster
+
+        tar_path = str(tmp_path / "cluster.tar")
+        with InProcessCluster(3, replica_n=1) as c:
+            c.create_index("cb")
+            c.create_field("cb", "f")
+            c.create_index("ckb", {"keys": True})
+            c.create_field("ckb", "kf", {"keys": True})
+            bits = [(1, s * SHARD_WIDTH + s) for s in range(12)]
+            c.import_bits("cb", "f", bits)
+            c.query(0, "ckb", 'Set("alpha", kf="r1")')
+            c.query(1, "ckb", 'Set("beta", kf="r1")')
+            # back up through a NON-coordinator node, with NO
+            # anti-entropy pass (the primary's log must be fetched
+            # directly, not a possibly-stale replica copy)
+            non_coord = next(
+                i
+                for i, n in enumerate(c.nodes)
+                if n.node_id != c.coordinator_id
+            )
+            assert (
+                cmd_backup(
+                    self._args(
+                        c.nodes[non_coord], output=tar_path, index=None
+                    )
+                )
+                == 0
+            )
+
+        with InProcessCluster(2, replica_n=1) as d:
+            non_coord = next(
+                i
+                for i, n in enumerate(d.nodes)
+                if n.node_id != d.coordinator_id
+            )
+            assert (
+                cmd_restore(self._args(d.nodes[non_coord], file=tar_path))
+                == 0
+            )
+            # all 12 shards' bits survived (they lived on 3 different
+            # source nodes)
+            assert (
+                d.query(0, "cb", "Count(Row(f=1))")["results"][0] == 12
+            )
+            res = d.query(1, "ckb", 'Row(kf="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta"]
+            # new keys allocate on the primary WITHOUT colliding with
+            # restored ids
+            d.query(non_coord, "ckb", 'Set("gamma", kf="r1")')
+            res = d.query(0, "ckb", 'Row(kf="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta", "gamma"]
+
+    def test_round_trip(self, tmp_path):
+        from pilosa_tpu.cli import cmd_backup, cmd_restore
+        from pilosa_tpu.core.field import FieldOptions
+
+        src = NodeServer(data_dir=str(tmp_path / "src"))
+        src.start()
+        try:
+            src.api.create_index("b", {"keys": False})
+            src.api.create_field("b", "f", {})
+            src.api.create_field(
+                "b", "v", {"type": "int", "min": 0, "max": 1000}
+            )
+            src.api.create_index("kb", {"keys": True})
+            src.api.create_field("kb", "kf", {"keys": True})
+            q = " ".join(
+                f"Set({c}, f={r})"
+                for r, c in [(1, 3), (1, SHARD_WIDTH + 9), (2, 7)]
+            )
+            src.api.query("b", q)
+            src.api.query("b", "Set(3, v=250) Set(9, v=990)")
+            src.api.query("kb", 'Set("alpha", kf="r1") Set("beta", kf="r1")')
+
+            tar_path = str(tmp_path / "backup.tar")
+            assert (
+                cmd_backup(self._args(src, output=tar_path, index=None)) == 0
+            )
+        finally:
+            src.stop()
+
+        dst = NodeServer(data_dir=str(tmp_path / "dst"))
+        dst.start()
+        try:
+            assert cmd_restore(self._args(dst, file=tar_path)) == 0
+            res = dst.api.query("b", "Row(f=1)")["results"][0]
+            assert sorted(res["columns"]) == [3, SHARD_WIDTH + 9]
+            assert dst.api.query("b", "Count(Row(f=2))")["results"][0] == 1
+            assert dst.api.query("b", "Sum(field=v)")["results"][0] == {
+                "value": 1240,
+                "count": 2,
+            }
+            res = dst.api.query("kb", 'Row(kf="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta"]
+            # restored translations kept their EXACT ids, so new keys
+            # don't collide with restored ones
+            dst.api.query("kb", 'Set("gamma", kf="r1")')
+            res = dst.api.query("kb", 'Row(kf="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta", "gamma"]
+        finally:
+            dst.stop()

@@ -295,6 +295,85 @@ def test_keyed_index_in_cluster(cluster3):
     assert cluster3.query(1, "ck", 'Count(Row(f="r2"))')["results"][0] == 1
 
 
+def test_translate_log_replication_and_primary_takeover():
+    """Replicas stream the primary's key log (reference translate.go:91-97
+    + cluster.go:1983-1996): after a sync pass every node serves
+    ids->keys locally and holds a full local .keys-feedable copy; when
+    the primary dies, reads keep working on replicas, and after
+    set-coordinator takeover, NEW key allocation resumes on the new
+    primary with no translations lost."""
+    with InProcessCluster(3, replica_n=2) as c:
+        c.create_index("ck2", {"keys": True})
+        c.create_field("ck2", "f", {"keys": True})
+        # keyed columns allocate sequential ids -> they all land in
+        # shard 0; make the translation primary (= coordinator) the one
+        # node NOT replicating shard 0, so writes can survive its death
+        replica_ids = {
+            n.id for n in c.nodes[0].cluster.shard_nodes("ck2", 0)
+        }
+        primary = next(n for n in c.nodes if n.node_id not in replica_ids)
+        c.nodes[0].api.set_coordinator(primary.node_id)
+        c.coordinator_id = primary.node_id
+        survivors = [n for n in c.nodes if n is not primary]
+
+        c.query(0, "ck2", 'Set("alpha", f="r1")')
+        c.query(1, "ck2", 'Set("beta", f="r1")')
+        c.query(2, "ck2", 'Set("gamma", f="r2")')
+
+        # replicate the key log (anti-entropy carrier)
+        stats = c.sync_all()
+        assert stats["translate_entries"] > 0
+        # every survivor's LOCAL store now holds every mapping
+        baseline = {}
+        for n in survivors:
+            local = n.api.executor.translator.local
+            got = local.translate_keys(
+                "ck2", "", ["alpha", "beta", "gamma"], create=False
+            )
+            assert all(i != 0 for i in got), (n.node_id, got)
+            baseline[n.node_id] = got
+
+        # ---- kill the translation primary -----------------------------
+        pi = next(i for i, n in enumerate(c.nodes) if n is primary)
+        c.stop_node(pi)
+
+        # ids->keys reads are served from the replicated local copies
+        for n in survivors:
+            idx_node = next(
+                i for i, m in enumerate(c.nodes) if m is n
+            )
+            res = c.query(idx_node, "ck2", 'Row(f="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta"]
+
+        # ---- takeover: move the primary role to a survivor -------------
+        new_primary = survivors[0]
+        new_primary.api.set_coordinator(new_primary.node_id)
+        for n in survivors:
+            assert n.cluster.coordinator_id == new_primary.node_id
+
+        # NEW key allocation resumes (forwarded to the new primary by
+        # the other survivor) and loses nothing
+        wi = next(i for i, m in enumerate(c.nodes) if m is survivors[1])
+        c.query(wi, "ck2", 'Set("delta", f="r1")')
+        for n in survivors:
+            i = next(j for j, m in enumerate(c.nodes) if m is n)
+            res = c.query(i, "ck2", 'Row(f="r1")')["results"][0]
+            assert sorted(res["keys"]) == ["alpha", "beta", "delta"]
+        # old ids unchanged on the new primary (no reallocation) and the
+        # new key got a fresh non-colliding id
+        local = new_primary.api.executor.translator.local
+        assert (
+            local.translate_keys(
+                "ck2", "", ["alpha", "beta", "gamma"], create=False
+            )
+            == baseline[new_primary.node_id]
+        )
+        ids = local.translate_keys(
+            "ck2", "", ["alpha", "beta", "gamma", "delta"], create=False
+        )
+        assert 0 not in ids and len(set(ids)) == 4
+
+
 def test_remote_available_shards_propagate(cluster3):
     cluster3.create_index("ci6")
     cluster3.create_field("ci6", "f")
