@@ -401,11 +401,30 @@ class Fragment:
         finally:
             self.store.end_batch()
 
+    def _counts_delta(self, counts0, slots, deltas) -> None:
+        """Carry the cached per-slot popcounts across a write (caller
+        holds the lock and captured ``counts0 = self._counts`` BEFORE
+        mutating — _touch/_slot null it), zero-padding for rows created
+        by the write.  ``slots``/``deltas`` are a scalar pair (point
+        write) or aligned arrays (import batch).  The ranked-cache role
+        of reference cache.go:158/fragment.go:698-712: TopN keeps
+        serving from maintained counts instead of rescanning."""
+        if counts0 is None:
+            return
+        n = len(self._rowids)
+        if len(counts0) < n:
+            counts0 = np.concatenate(
+                [counts0, np.zeros(n - len(counts0), dtype=np.int64)]
+            )
+        counts0[slots] += deltas
+        self._counts = counts0
+
     def set_bit(self, row: int, col: int) -> bool:
         """Set bit (row, col-offset); returns True if it changed
         (reference fragment.go:645-713)."""
         with self._lock:
             self._check_persistable(row)
+            counts0 = self._counts
             s = self._slot(row, create=True)
             w, b = col >> 5, np.uint32(1 << (col & 31))
             if self._host[s, w] & b:
@@ -413,6 +432,7 @@ class Fragment:
             self._host[s, w] |= b
             self._delta_note_word(s, w)
             self._touch(s, tracked=True)
+            self._counts_delta(counts0, s, 1)
             if self.store is not None:
                 self.store.log_add(row, col)
             return True
@@ -425,9 +445,11 @@ class Fragment:
             w, b = col >> 5, np.uint32(1 << (col & 31))
             if not self._host[s, w] & b:
                 return False
+            counts0 = self._counts
             self._host[s, w] &= ~b
             self._delta_note_word(s, w)
             self._touch(s, tracked=True)
+            self._counts_delta(counts0, s, -1)
             if self.store is not None:
                 self.store.log_remove(row, col)
             return True
@@ -528,6 +550,7 @@ class Fragment:
         if rows.size == 0:
             return 0
         with self._lock, self._batched_store():
+            counts0 = self._counts  # before _slot creation nulls it
             # Group by row directly (never via row*width+col positions,
             # which would wrap uint64 for hashed row ids).
             row_ids, inverse = np.unique(rows, return_inverse=True)
@@ -609,7 +632,13 @@ class Fragment:
                         self.store.log_remove_positions(positions)
                     else:
                         self.store.log_add_positions(positions)
-                self._counts = None
+                # carry the cached per-slot popcounts across the batch —
+                # the per-row changed-bit counts are a by-product of the
+                # merge, so TopN keeps serving without a rescan
+                # (reference cache.go:158 ranked-cache maintenance)
+                self._counts_delta(
+                    counts0, slots, -per_row if clear else per_row
+                )
                 self.version += 1
                 self.op_n += len(changed_idx)
                 if self.on_op is not None:
@@ -859,20 +888,18 @@ class Fragment:
 
     def row_counts(self) -> tuple[list[int], np.ndarray]:
         """(row_ids, per-row popcounts) over existing rows — the TopN
-        ranked-cache analogue (reference cache.go; recounted like
-        fragment.go:459-498 but vectorized on device; host popcount when
-        the fragment exceeds the HBM budget)."""
+        ranked-cache analogue (reference cache.go).  Counts are
+        MAINTAINED across writes (point deltas and import batches carry
+        them, like the reference's incremental cache updates,
+        fragment.go:698-712) and recomputed from the host mirror only
+        when absent — never a device round trip, so a lone TopN stays
+        in the latency tier."""
         with self._lock:
             if self._counts is None or len(self._counts) != len(self._rowids):
-                if self.device_declined():
-                    self._counts = (
-                        np.bitwise_count(self._host)
-                        .sum(axis=1, dtype=np.int64)[: len(self._rowids)]
-                    )
-                else:
-                    bits = self.device_bits()
-                    counts = np.asarray(bitops.count_rows(bits))
-                    self._counts = counts[: len(self._rowids)]
+                n = len(self._rowids)
+                self._counts = np.bitwise_count(self._host[:n]).sum(
+                    axis=1, dtype=np.int64
+                )
             ids = list(self._rowids)
             return ids, self._counts.copy()
 

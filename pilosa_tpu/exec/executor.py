@@ -1971,11 +1971,16 @@ class Executor:
         counts: dict[int, int] = {}
         src_count = src.count() if src is not None else 0
         row_totals: dict[int, int] = {}
-        if view is not None:
+        # Two-tier dispatch: UNFILTERED TopN is served from the
+        # MAINTAINED per-fragment counts (host, no device work, stays
+        # correct across writes via the import/point-write delta
+        # carrying — the reference's ranked cache, cache.go:158); the
+        # stack path is the throughput tier for FILTERED TopN where a
+        # masked-count kernel earns its launch.
+        if view is not None and src is not None:
             # One launch over the cached field stack answers every
-            # (shard, row) at once — unfiltered via the row-scan kernel,
-            # filtered via the masked-count kernel (replacing the
-            # reference's per-fragment cache merge and the per-shard
+            # (shard, row) at once via the masked-count kernel (replacing
+            # the reference's per-fragment cache merge and the per-shard
             # filter loop, fragment.go:1586-1655).
             from pilosa_tpu.ops import kernels
 
@@ -1991,51 +1996,80 @@ class Executor:
                     stack = None
             if stack is not None:
                 slot_of, bits = stack
-                if src is None:
+                S, _, W = bits.shape
+                filt = self._row_to_shard_matrix(src, shards, S, W)
+                mc = kernels.masked_row_counts(bits, filt)
+                for rid, slot in slot_of.items():
+                    if mc[slot]:
+                        counts[rid] = int(mc[slot])
+                if has_tanimoto:
                     rc = self._stack_row_counts(field, bits)
                     for rid, slot in slot_of.items():
                         if rc[slot]:
-                            counts[rid] = int(rc[slot])
-                else:
-                    S, _, W = bits.shape
-                    filt = self._row_to_shard_matrix(src, shards, S, W)
-                    mc = kernels.masked_row_counts(bits, filt)
-                    for rid, slot in slot_of.items():
-                        if mc[slot]:
-                            counts[rid] = int(mc[slot])
-                    if has_tanimoto:
-                        rc = self._stack_row_counts(field, bits)
-                        for rid, slot in slot_of.items():
-                            if rc[slot]:
-                                row_totals[rid] = int(rc[slot])
+                            row_totals[rid] = int(rc[slot])
                 view = None  # stack covered every shard; skip the loop
-        if view is not None:
+        if view is not None and src is None:
+            # vectorized merge of the maintained per-fragment counts:
+            # concatenate (ids, counts) across shards and reduce by row
+            # id — no per-(shard, row) Python work
+            id_parts: list[np.ndarray] = []
+            count_parts: list[np.ndarray] = []
             for shard in shards:
                 frag = view.fragment(shard)
                 if frag is None:
                     continue
                 ids, row_counts = frag.row_counts()
-                if src is not None:
-                    if has_tanimoto:
-                        # Row totals accumulate over every shard the row
-                        # exists in, even where the src bitmap is empty —
-                        # the tanimoto denominator needs the full row
-                        # cardinality.
-                        for rid, t in zip(ids, row_counts.tolist()):
-                            row_totals[rid] = row_totals.get(rid, 0) + t
-                    seg = src.segments.get(shard)
-                    if seg is None:
-                        continue
-                    inter = np.asarray(
-                        bitops.count_rows(frag.rows_device(ids) & seg[None, :])
-                    )
-                    for rid, c in zip(ids, inter.tolist()):
-                        if c:
-                            counts[rid] = counts.get(rid, 0) + c
+                if ids:
+                    id_parts.append(np.asarray(ids, dtype=np.int64))
+                    count_parts.append(row_counts)
+            if id_parts:
+                cat_ids = np.concatenate(id_parts)
+                cat_counts = np.concatenate(count_parts)
+                uids, inv = np.unique(cat_ids, return_inverse=True)
+                sums = np.bincount(
+                    inv, weights=cat_counts, minlength=len(uids)
+                ).astype(np.int64)
+                nz = sums > 0
+                counts = {
+                    int(r): int(c)
+                    for r, c in zip(uids[nz], sums[nz])
+                }
+            view = None  # merged every shard; skip the loop below
+        if view is not None:
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                # this loop only runs FILTERED (src set): the unfiltered
+                # case merged maintained counts above
+                ids, row_counts = frag.row_counts()
+                if has_tanimoto:
+                    # Row totals accumulate over every shard the row
+                    # exists in, even where the src bitmap is empty —
+                    # the tanimoto denominator needs the full row
+                    # cardinality.
+                    for rid, t in zip(ids, row_counts.tolist()):
+                        row_totals[rid] = row_totals.get(rid, 0) + t
+                seg = src.segments.get(shard)
+                if seg is None:
+                    continue
+                if isinstance(seg, np.ndarray):
+                    # host-tier filter: fused count against the host
+                    # mirror, no device round trip
+                    mids, matrix = frag.rows_matrix_host()
+                    inter = np.bitwise_count(
+                        matrix & seg[None, :]
+                    ).sum(axis=1, dtype=np.int64)
+                    ids = mids
                 else:
-                    for rid, c in zip(ids, row_counts.tolist()):
-                        if c:
-                            counts[rid] = counts.get(rid, 0) + c
+                    inter = np.asarray(
+                        bitops.count_rows(
+                            frag.rows_device(ids) & seg[None, :]
+                        )
+                    )
+                for rid, c in zip(ids, inter.tolist()):
+                    if c:
+                        counts[rid] = counts.get(rid, 0) + c
 
         if has_ids and ids_arg is not None:
             counts = {r: counts.get(r, 0) for r in ids_arg}

@@ -1,0 +1,39 @@
+"""Full-width tier: the real SHARD_WIDTH=2^20 shapes, in a subprocess
+(the package reads PILOSA_TPU_SHARD_WIDTH at import, and conftest pins
+the in-process suite to 2^14).  Run just this tier with
+
+    python -m pytest -m fullwidth
+
+Covers the thresholds the small-width suite can't cross: real-width
+import/WAL, capacity growth, host-tier counts, gram int32 chunking, and
+the psum carry-save mesh reduce (tests/_fullwidth_check.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fullwidth
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "_fullwidth_check.py")
+
+
+def test_fullwidth_suite():
+    env = dict(os.environ)
+    env["PILOSA_TPU_SHARD_WIDTH"] = "20"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env.setdefault("JAX_NUM_CPU_DEVICES", "8")
+    r = subprocess.run(
+        [sys.executable, _SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=480,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(_SCRIPT)),
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "FULLWIDTH ALL OK" in r.stdout

@@ -114,11 +114,33 @@ def _truth_topn(h, n):
 
 def test_queries_complete_under_small_cap(restore_budget):
     """Fragments collectively exceed the cap: LRU eviction cycles device
-    copies; results stay correct and residency stays capped."""
+    copies; results stay correct and residency stays capped.
+
+    Lone pair counts and unfiltered TopN are host-tier now (zero device
+    residency by design), so the device-cycling queries here are BSI
+    aggregates — their per-shard fallback pages fragment tensors
+    through the budget."""
+    from pilosa_tpu.core.field import FieldOptions
+
     h, ex = _build_holder()
-    # one fragment device copy is ~ (cap+1)*W*4; allow roughly two
-    frag_bytes = 10 * h.n_words * 4
-    budget = membudget.configure(2 * frag_bytes)
+    idx = h.index("i")
+    idx.create_field("v", FieldOptions(field_type="int", min_=0, max_=10**6))
+    rng = np.random.default_rng(7)
+    width = h.n_words * 32
+    vals = {}
+    for col in rng.choice(6 * width, size=120, replace=False):
+        vals[int(col)] = int(rng.integers(0, 10**6))
+    ex.execute("i", " ".join(f"Set({c}, v={x})" for c, x in vals.items()))
+    # budget fits ~2.5 BSI fragment tensors, so the 6-shard sweep must
+    # admit and EVICT device copies as it pages through
+    vview = idx.field("v").view("bsig_v")
+    frag_bytes = max(
+        f.capacity * f.n_words * 4 for f in vview.fragments.values()
+    )
+    budget = membudget.configure(int(2.5 * frag_bytes))
+    got = ex.execute("i", "Sum(field=v)")[0]
+    assert got.value == sum(vals.values()) and got.count == len(vals)
+    # host-tier queries still answer correctly with zero device work
     res = ex.execute(
         "i",
         "Count(Intersect(Row(f=0), Row(f=1))) Count(Intersect(Row(f=2), Row(f=3)))",

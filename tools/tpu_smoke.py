@@ -75,9 +75,19 @@ def main() -> int:
     top = ex.execute("s", "TopN(f, n=3)")[0]
     by_count = sorted(rows_f, key=lambda r: (-len(rows_f[r]), r))
     assert [p.id for p in top] == by_count[:3]
-    ex.execute("s", "TopN(f, n=3)")
-    assert ex.rowcount_cache_hits >= 1
-    print("PASS TopN (served)")
+    # unfiltered TopN serves from MAINTAINED per-fragment counts: after
+    # the first query every fragment carries its vector, and a write
+    # updates it as a delta (no rescan) — visible on the next query
+    view = h.index("s").field("f").view("standard")
+    assert all(fr._counts is not None for fr in view.fragments.values())
+    top_id, top_count = top[0].id, top[0].count
+    free_col = 2 * width - 3
+    ex.execute("s", f"Set({free_col}, f={top_id})")
+    delta = 0 if free_col in rows_f[top_id] else 1
+    rows_f[top_id].add(free_col)
+    top2 = ex.execute("s", "TopN(f, n=3)")[0]
+    assert top2[0].id == top_id and top2[0].count == top_count + delta
+    print("PASS TopN (maintained counts, write-fresh)")
 
     gb = {
         tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
