@@ -34,6 +34,8 @@ DEFAULT_CONFIG = {
     # reference api.go:66-96 importWorkerPoolSize (default 2)
     "import": {"workers": 2, "queue-depth": 16},
     "anti-entropy": {"interval": 600},
+    # reference server/config.go:160 MaxWritesPerRequest (0 disables)
+    "max-writes-per-request": 5000,
     "metric": {"service": "none", "poll-interval": 60, "diagnostics-sink": ""},
     "tracing": {"enabled": False},
 }
@@ -149,6 +151,7 @@ def cmd_server(args) -> int:
         or tls_cfg.get("ca-certificate")
         or None,
         import_workers=int(cfg.get("import", {}).get("workers", 2)),
+        max_writes_per_request=int(cfg.get("max-writes-per-request", 5000)),
         import_queue_depth=int(cfg.get("import", {}).get("queue-depth", 16)),
     )
     # tracing exporter + sampler (reference tracing config

@@ -131,6 +131,15 @@ class DistributedExecutor:
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name}")
         q = pql.parse(query) if isinstance(query, str) else query
+        # the write cap guards the COORDINATOR boundary for clustered
+        # queries too (reference executor.go:138 runs for every Execute)
+        if (
+            self.local.max_writes_per_request > 0
+            and len(q.write_calls()) > self.local.max_writes_per_request
+        ):
+            from pilosa_tpu.exec.executor import TooManyWritesError
+
+            raise TooManyWritesError("too many write commands")
         # coordinator-side span (reference executor.go:117); remote fan-out
         # joins it via injected headers in InternalClient._do
         with tracing.start_span("executor.Execute").set_tag("index", index_name):

@@ -97,6 +97,10 @@ class ExecuteError(Exception):
     pass
 
 
+class TooManyWritesError(ExecuteError):
+    """reference pilosa.go:59 ErrTooManyWrites."""
+
+
 class IndexNotFoundError(ExecuteError):
     pass
 
@@ -106,9 +110,24 @@ class FieldNotFoundError(ExecuteError):
 
 
 class Executor:
-    def __init__(self, holder: Holder, translator: TranslateStore | None = None):
+    # reference server/config.go:160 MaxWritesPerRequest default
+    DEFAULT_MAX_WRITES_PER_REQUEST = 5000
+
+    def __init__(
+        self,
+        holder: Holder,
+        translator: TranslateStore | None = None,
+        max_writes_per_request: int | None = None,
+    ):
         self.holder = holder
         self.translator = translator or TranslateStore()
+        # mutating-call cap per request (reference executor.go:55,138 +
+        # config max-writes-per-request); 0 disables
+        self.max_writes_per_request = (
+            self.DEFAULT_MAX_WRITES_PER_REQUEST
+            if max_writes_per_request is None
+            else max_writes_per_request
+        )
         # stack maintenance accounting (tested: incremental refresh must
         # replace full re-uploads on write-interleaved workloads)
         self.stack_rebuilds = 0
@@ -139,6 +158,12 @@ class Executor:
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name}")
         q = pql.parse(query) if isinstance(query, str) else query
+        if (
+            self.max_writes_per_request > 0
+            and len(q.write_calls()) > self.max_writes_per_request
+        ):
+            # reference executor.go:138 + pilosa.go:59 ErrTooManyWrites
+            raise TooManyWritesError("too many write commands")
         # span per query (reference executor.go:117 "Executor.Execute")
         with tracing.start_span("executor.Execute").set_tag("index", index_name):
             calls = [c.clone() for c in q.calls]
@@ -1989,9 +2014,8 @@ class Executor:
                 # masked counts aren't supported on process-spanning
                 # stacks (nor plain counts past their int32 bound);
                 # the per-fragment loop below answers instead
-                if (
-                    src is not None
-                    and kernels.stack_spans_processes(stack[1])
+                if kernels.stack_spans_processes(
+                    stack[1]
                 ) or not kernels.row_counts_supported(stack[1]):
                     stack = None
             if stack is not None:

@@ -18,13 +18,18 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
   into one index read, and the Pallas variant keeps the 32x int8
   expansion in VMEM instead of HBM.
 * **Fused XLA scans** for per-row popcounts (TopN) and everything else:
-  measured 154 GB/s vs 106 GB/s for the best hand-written Pallas
-  streaming kernel on the same shape — XLA's fusion of
-  ``popcount + reduce`` beats manual VMEM staging here, so Pallas is OFF
-  by default (``PILOSA_TPU_PALLAS=1`` re-enables the row-scan kernels
-  for hardware where the balance differs; they compile on real TPU —
+  measured ~107 GB/s on v5e at the 10.7e9-bit shape, and every
+  alternative plateaus there too (hand-blocked Pallas staging at
+  several tile sizes, and MXU dot-reduce of the popcount bytes all
+  measure 103-107 GB/s) — the bound is the VPU popcount+accumulate
+  rate (~27 G words/s), not HBM or scheduling, so XLA's fusion is
+  already at the op's hardware ceiling and Pallas is OFF by default
+  (``PILOSA_TPU_PALLAS=1`` re-enables the row-scan kernels for
+  hardware where the balance differs; they compile on real TPU —
   (8-shard, full-row, word-block) tiles — and validate under interpret
-  mode in tests).  The earlier scalar-prefetch pair-count kernels were
+  mode in tests).  Architecturally the cold scan is also mostly
+  retired: unfiltered TopN serves from counts MAINTAINED across writes
+  (core/fragment.py), so the scan only runs on stack rebuilds.  The earlier scalar-prefetch pair-count kernels were
   REMOVED: their one-row blocks violate the TPU (8, 128) tiling rule
   outright, and the gram path supersedes them.
 """

@@ -10,6 +10,8 @@ special call forms falling back to the generic ``IDENT(allargs)`` rule).
 from __future__ import annotations
 
 import re
+import threading
+from collections import OrderedDict
 from typing import Any
 
 from pilosa_tpu.pql.ast import Call, Condition, Query
@@ -442,6 +444,31 @@ class _Parser:
         return call
 
 
+# Parsed-AST cache for SHORT queries (the serving shapes — lone counts,
+# TopN, GroupBy — repeat with varying literals, and parsing costs ~half
+# of a warm cache-served round trip).  Long strings (bulk write batches)
+# are one-shot and would only bloat the key memory, so they bypass.
+# Cached Querys are never handed out directly: callers receive a fresh
+# clone per parse, because the executor mutates call args in place
+# (key translation).
+_PARSE_CACHE_MAX_LEN = 256
+_parse_cache: "OrderedDict[str, Query]" = OrderedDict()
+_PARSE_CACHE_ENTRIES = 4096
+_parse_cache_lock = threading.Lock()
+
+
 def parse(src: str) -> Query:
     """Parse a PQL string into a Query (reference pql/parser.go Parse)."""
-    return _Parser(src).parse()
+    if len(src) > _PARSE_CACHE_MAX_LEN:
+        return _Parser(src).parse()
+    with _parse_cache_lock:
+        q = _parse_cache.get(src)
+        if q is not None:
+            _parse_cache.move_to_end(src)
+            return Query([c.clone() for c in q.calls])
+    q = _Parser(src).parse()
+    with _parse_cache_lock:
+        _parse_cache[src] = Query([c.clone() for c in q.calls])
+        while len(_parse_cache) > _PARSE_CACHE_ENTRIES:
+            _parse_cache.popitem(last=False)
+    return q

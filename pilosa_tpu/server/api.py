@@ -71,6 +71,7 @@ class API:
         broadcaster=None,
         import_workers: int = 2,
         import_queue_depth: int = 16,
+        max_writes_per_request: int | None = None,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -78,7 +79,11 @@ class API:
         self.client = client
         self.broadcaster = broadcaster
         translator = store.translator if store is not None else None
-        self.executor = Executor(self.holder, translator=translator)
+        self.executor = Executor(
+            self.holder,
+            translator=translator,
+            max_writes_per_request=max_writes_per_request,
+        )
         # Cluster-aware execution path (reference executor.go mapReduce);
         # collapses to the local executor on a single node.
         self.dist = None
@@ -930,6 +935,46 @@ class API:
         for index, field, key, id_ in entries:
             local.set_mapping(index, field, [key], [int(id_)])
         return {"restored": len(entries)}
+
+    def resize_abort(self) -> dict:
+        """Abort/clear a resize: re-commit the CURRENT membership with
+        state NORMAL on every reachable node (reference api.go:1249
+        ResizeAbort).  Our resize runs synchronously and self-aborts on
+        failure, so this is the operator's recovery hammer for a
+        cluster left in RESIZING by a mid-resize coordinator crash.
+        Valid only on the coordinator."""
+        self._validate("ResizeAbort")
+        if self.cluster is None:
+            raise ApiError("cluster not configured", 400)
+        if not self.cluster.is_coordinator:
+            raise ApiError("resize-abort must run on the coordinator", 400)
+        from pilosa_tpu.cluster.resize import ResizeCoordinator
+
+        rc = ResizeCoordinator(self.cluster, self.client, self)
+        nodes = list(self.cluster.nodes)
+        rc._commit_membership(nodes, nodes)
+        return {"aborted": True}
+
+    def resize_remove_node(self, node_id: str) -> dict:
+        """Remove a node through the resize protocol (reference
+        api.go:1214 RemoveNode + POST /cluster/resize/remove-node).
+        Valid only on the coordinator."""
+        self._validate("RemoveNode")
+        if self.cluster is None:
+            raise ApiError("cluster not configured", 400)
+        if not self.cluster.is_coordinator:
+            raise ApiError("remove-node must run on the coordinator", 400)
+        if self.cluster.node(node_id) is None:
+            raise ApiError(f"unknown node: {node_id}", 400)
+        from pilosa_tpu.cluster.resize import ResizeCoordinator, ResizeError
+
+        try:
+            ResizeCoordinator(self.cluster, self.client, self).remove_node(
+                node_id
+            )
+        except ResizeError as e:
+            raise ApiError(str(e), 400)
+        return {"removed": node_id}
 
     def set_coordinator(self, node_id: str) -> dict:
         """Move the coordinator (and with it the translation-primary

@@ -65,6 +65,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/translate/log$"), "translate_log"),
     ("POST", re.compile(r"^/internal/translate/restore$"), "translate_restore"),
     ("POST", re.compile(r"^/cluster/resize/set-coordinator$"), "set_coordinator"),
+    ("POST", re.compile(r"^/cluster/resize/abort$"), "resize_abort"),
+    ("POST", re.compile(r"^/cluster/resize/remove-node$"), "remove_node"),
+    ("POST", re.compile(r"^/recalculate-caches$"), "recalculate_caches"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "cluster_message"),
     ("GET", re.compile(r"^/internal/attr/blocks$"), "attr_blocks"),
     ("POST", re.compile(r"^/internal/attr/block/data$"), "attr_block_data"),
@@ -408,6 +411,18 @@ class Handler(BaseHTTPRequestHandler):
     def r_set_coordinator(self):
         body = self._json_body()
         self._send_json(200, self.api.set_coordinator(body.get("id", "")))
+
+    def r_resize_abort(self):
+        self._send_json(200, self.api.resize_abort())
+
+    def r_remove_node(self):
+        body = self._json_body()
+        self._send_json(200, self.api.resize_remove_node(body.get("id", "")))
+
+    def r_recalculate_caches(self):
+        # reference POST /recalculate-caches; counts here are exact and
+        # maintained, so there is nothing to rebuild (docs/parity.md)
+        self._send_json(200, {})
 
 
 class Server:

@@ -42,6 +42,7 @@ class NodeServer:
         tls_ca_cert: str | None = None,
         import_workers: int = 2,
         import_queue_depth: int = 16,
+        max_writes_per_request: int | None = None,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -71,6 +72,7 @@ class NodeServer:
             broadcaster=self.broadcaster,
             import_workers=import_workers,
             import_queue_depth=import_queue_depth,
+            max_writes_per_request=max_writes_per_request,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

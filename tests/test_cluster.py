@@ -505,3 +505,75 @@ def test_parallel_replica_write_fanout():
         for n in c.nodes:
             frag = n.holder.fragment("pw", "f", "standard", 0)
             assert frag is not None and frag.get_bit(7, 3)
+
+
+def test_remove_node_and_abort_over_http():
+    """Operator endpoints (reference http/handler.go routes
+    /cluster/resize/remove-node and /cluster/resize/abort +
+    /recalculate-caches): remove a node through the resize protocol via
+    HTTP, and clear a stuck RESIZING state with abort."""
+    import json as _json
+    import urllib.request
+
+    def post(uri, path, body=None):
+        req = urllib.request.Request(
+            f"{uri}{path}",
+            data=_json.dumps(body or {}).encode(),
+            method="POST",
+        )
+        return _json.load(urllib.request.urlopen(req))
+
+    with InProcessCluster(3, replica_n=2) as c:
+        c.create_index("rn")
+        c.create_field("rn", "f")
+        bits = [(1, s * SHARD_WIDTH + 7) for s in range(9)]
+        c.import_bits("rn", "f", bits)
+        coord = c.coordinator
+        # recalculate-caches: accepted no-op
+        assert post(coord.uri, "/recalculate-caches") == {}
+        victim = next(n for n in c.nodes if n.node_id != coord.node_id)
+        out = post(coord.uri, "/cluster/resize/remove-node", {"id": victim.node_id})
+        assert out == {"removed": victim.node_id}
+        survivors = [n for n in c.nodes if n is not victim]
+        for n in survivors:
+            assert len(n.cluster.nodes) == 2
+            assert n.api.state == "NORMAL"
+        # data survived the removal (replica_n=2 covered every shard)
+        got = survivors[0].api.query("rn", "Count(Row(f=1))")["results"][0]
+        assert got == 9
+        victim.stop()
+        c.nodes.remove(victim)
+
+        # wedge a node in RESIZING, then abort from the coordinator
+        survivors[1].api.receive_message(
+            {"type": "cluster-status", "state": "RESIZING"}
+        )
+        assert survivors[1].api.state == "RESIZING"
+        out = post(coord.uri, "/cluster/resize/abort")
+        assert out == {"aborted": True}
+        for n in survivors:
+            assert n.api.state == "NORMAL"
+        got = survivors[1].api.query("rn", "Count(Row(f=1))")["results"][0]
+        assert got == 9
+
+
+def test_max_writes_enforced_on_cluster_path(cluster3):
+    """The write cap guards the coordinator boundary for clustered
+    queries too (reference executor.go:138 runs for every Execute)."""
+    from pilosa_tpu.server.api import ApiError
+
+    cluster3.create_index("mw")
+    cluster3.create_field("mw", "f")
+    for n in cluster3.nodes:
+        n.api.executor.max_writes_per_request = 3
+    try:
+        cluster3.query(1, "mw", "Set(1, f=1) Set(2, f=1) Set(3, f=1)")
+        with pytest.raises(ApiError):
+            cluster3.query(
+                1, "mw", "Set(1, f=1) Set(2, f=1) Set(3, f=1) Set(4, f=1)"
+            )
+    finally:
+        for n in cluster3.nodes:
+            n.api.executor.max_writes_per_request = (
+                n.api.executor.DEFAULT_MAX_WRITES_PER_REQUEST
+            )

@@ -577,3 +577,37 @@ class TestReviewRegressions2:
         paged = e.execute("ki", 'GroupBy(Rows(g), previous=["x"])')[0]
         assert len(paged) == 2
         assert all(gc.group[0].row_key in ("y", "z") for gc in paged)
+
+
+class TestMaxWritesPerRequest:
+    """reference executor.go:138 + pilosa.go:59 ErrTooManyWrites and the
+    max-writes-per-request config (server/config.go:160, default 5000)."""
+
+    def test_over_limit_rejected_under_limit_ok(self):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor, TooManyWritesError
+
+        h = Holder()
+        h.create_index("i")
+        h.index("i").create_field("f")
+        ex = Executor(h, max_writes_per_request=3)
+        ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=1)")  # == limit
+        with pytest.raises(TooManyWritesError):
+            ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=1) Set(4, f=1)")
+        # reads don't count toward the write cap
+        ex.execute(
+            "i",
+            "Count(Row(f=1)) Count(Row(f=1)) Count(Row(f=1)) Set(9, f=1)",
+        )
+
+    def test_zero_disables(self):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+
+        h = Holder()
+        h.create_index("i")
+        h.index("i").create_field("f")
+        ex = Executor(h, max_writes_per_request=0)
+        q = " ".join(f"Set({c}, f=1)" for c in range(50))
+        ex.execute("i", q)
+        assert ex.execute("i", "Count(Row(f=1))")[0] == 50
