@@ -175,6 +175,11 @@ def cmd_server(args) -> int:
         node.diagnostics.sink_path = os.path.expanduser(diag_sink)
         node.diagnostics.start(float(metric_cfg.get("poll-interval", 60) or 60))
     node.start()
+    # periodic replica repair + translate-log replication (reference
+    # server.go:494-546 monitorAntiEntropy; 0 disables)
+    node.start_anti_entropy(
+        float(cfg.get("anti-entropy", {}).get("interval", 600) or 0)
+    )
     print(f"pilosa-tpu server listening on {node.uri}, data dir {data_dir}")
     try:
         import threading

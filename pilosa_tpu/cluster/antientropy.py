@@ -308,20 +308,35 @@ class HolderSyncer:
 
 
 class AntiEntropyLoop:
-    """Background interval loop (reference server.go:494-546)."""
+    """Background interval loop (reference server.go:494-546).
 
-    def __init__(self, syncer: HolderSyncer, interval: float):
+    ``state_fn`` (when given) gates each pass: only RESIZING/STARTING
+    skip — DEGRADED deliberately still syncs, because repair between
+    the surviving replicas matters MOST during an outage (the
+    reference's monitorAntiEntropy skips only resizing)."""
+
+    _SKIP_STATES = ("RESIZING", "STARTING")
+
+    def __init__(self, syncer: HolderSyncer, interval: float, state_fn=None):
         self.syncer = syncer
         self.interval = interval
+        self.state_fn = state_fn
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="pilosa-antientropy", daemon=True
+        )
         self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
+            if (
+                self.state_fn is not None
+                and self.state_fn() in self._SKIP_STATES
+            ):
+                continue
             t0 = time.monotonic()
             try:
                 self.syncer.sync_holder()
@@ -332,5 +347,7 @@ class AntiEntropyLoop:
             except Exception as e:
                 logger.warning("anti-entropy pass failed: %s", e)
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 5.0) -> None:
         self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)

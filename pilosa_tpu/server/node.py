@@ -110,6 +110,7 @@ class NodeServer:
             gc_notifier=self.gc_notifier,
         )
         self.membership = None  # started on demand via start_membership()
+        self._ae_loop = None  # anti-entropy loop (start_anti_entropy)
 
     # -- shard availability broadcasts (reference view.go:239-261
     #    CreateShardMessage) ------------------------------------------------
@@ -177,6 +178,24 @@ class NodeServer:
         self.cluster.local_node.uri = self.uri
         self.runtime_monitor.start()
 
+    def start_anti_entropy(self, interval: float) -> None:
+        """Background anti-entropy loop (reference server.go:494-546
+        monitorAntiEntropy): one sync_holder pass per interval — block
+        checksum repair between replicas AND the translate-log
+        replication pull (translate_proxy.sync_from_primary rides this
+        carrier).  Runs even at replica_n=1 (translation still
+        replicates to non-primaries) and keeps running in DEGRADED
+        (repair between survivors matters most then); only
+        RESIZING/STARTING skip.  Idempotent; stop() ends it."""
+        from pilosa_tpu.cluster.antientropy import AntiEntropyLoop
+
+        if interval <= 0 or self._ae_loop is not None:
+            return
+        self._ae_loop = AntiEntropyLoop(
+            self.syncer(), interval, state_fn=lambda: self.api.state
+        )
+        self._ae_loop.start()
+
     @property
     def uri(self) -> str:
         scheme = "https" if self.tls else "http"
@@ -229,6 +248,11 @@ class NodeServer:
         return self.membership
 
     def stop(self) -> None:
+        if self._ae_loop is not None:
+            # the loop reference is kept even if a slow pass outlives the
+            # join timeout, so a restart can't spawn a second loop while
+            # the old pass is still running
+            self._ae_loop.stop()
         if self.membership is not None:
             self.membership.stop()
         if self.api.dist is not None:

@@ -577,3 +577,33 @@ def test_max_writes_enforced_on_cluster_path(cluster3):
             n.api.executor.max_writes_per_request = (
                 n.api.executor.DEFAULT_MAX_WRITES_PER_REQUEST
             )
+
+
+def test_anti_entropy_background_loop_converges_translation():
+    """The periodic anti-entropy loop (reference server.go:494-546
+    monitorAntiEntropy) carries translate-log replication: replicas
+    converge WITHOUT any manual sync call."""
+    import time
+
+    with InProcessCluster(3, replica_n=2) as c:
+        c.create_index("ae", {"keys": True})
+        c.create_field("ae", "f", {"keys": True})
+        for n in c.nodes:
+            n.start_anti_entropy(0.15)
+        c.query(0, "ae", 'Set("alpha", f="r1")')
+        c.query(1, "ae", 'Set("beta", f="r1")')
+        primary_id = c.nodes[0].cluster.translate_primary().id
+        replicas = [n for n in c.nodes if n.node_id != primary_id]
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            done = all(
+                0
+                not in n.api.executor.translator.local.translate_keys(
+                    "ae", "", ["alpha", "beta"], create=False
+                )
+                for n in replicas
+            )
+            if done:
+                break
+            time.sleep(0.1)
+        assert done, "replicas did not converge via the background loop"
