@@ -915,6 +915,36 @@ class Fragment:
         sign = self.row_device(BSI_SIGN_BIT)
         return planes, exists, sign
 
+    def fill_bsi_tensors_host(
+        self, bit_depth: int, planes_out, exists_out, sign_out
+    ) -> None:
+        """Host-mirror twin of :func:`bsi_tensors`: fill CALLER-OWNED
+        arrays (planes_out[bit_depth, W], exists_out[W], sign_out[W],
+        zero-initialized) from the mirror — the latency tier
+        preallocates one stacked buffer for all fragments, so a lone
+        cold BSI predicate costs exactly one field-sized host copy."""
+        with self._lock:
+            for k in range(bit_depth):
+                s = self._slot_of.get(BSI_OFFSET_BIT + k)
+                if s is not None:
+                    planes_out[k] = self._host[s]
+            se = self._slot_of.get(BSI_EXISTS_BIT)
+            if se is not None:
+                exists_out[:] = self._host[se]
+            ss = self._slot_of.get(BSI_SIGN_BIT)
+            if ss is not None:
+                sign_out[:] = self._host[ss]
+
+    def bsi_tensors_host(self, bit_depth: int):
+        """(planes[bit_depth, W], exists, sign) numpy copies — the
+        allocate-per-fragment convenience over
+        :func:`fill_bsi_tensors_host`."""
+        planes = np.zeros((bit_depth, self.n_words), dtype=np.uint32)
+        exists = np.zeros(self.n_words, dtype=np.uint32)
+        sign = np.zeros(self.n_words, dtype=np.uint32)
+        self.fill_bsi_tensors_host(bit_depth, planes, exists, sign)
+        return planes, exists, sign
+
     def set_value(self, col: int, bit_depth: int, value: int) -> bool:
         """Write a stored (already base-offset) value for a column
         (reference fragment.go:929-1003 setValueBase)."""

@@ -1303,10 +1303,15 @@ class Executor:
             raise ExecuteError(
                 f"range condition on non-int field {field.name!r}"
             )
+        # ONE warm-up decision per condition (a != evaluates two
+        # kernels; they must not double-count demand)
+        ready = self._bsi_single_ready(field, shards)
         op = cond.op
         if op == "!=" and cond.value is None:
             # f != null -> not-null (reference frag.notNull)
-            return self._bsi_rows(field, shards, lambda pl, ex, sg: ex)
+            return self._bsi_rows(
+                field, shards, lambda pl, ex, sg: ex, ready=ready
+            )
         if op == "==" and cond.value is None:
             raise ExecuteError("Range(): <field> == null is not supported")
         depth = field.bit_depth
@@ -1322,6 +1327,7 @@ class Executor:
                 lambda pl, ex, sg: fn(
                     pl, ex, sg, value=bound, depth=depth, allow_eq=allow_eq
                 ),
+                ready=ready,
             )
         if op in ("==", "!="):
             stored = int(cond.value) - base
@@ -1331,10 +1337,13 @@ class Executor:
                 lambda pl, ex, sg: bsi.range_eq(
                     pl, ex, sg, value_abs=abs(stored), negative=stored < 0, depth=depth
                 ),
+                ready=ready,
             )
             if op == "==":
                 return eq
-            notnull = self._bsi_rows(field, shards, lambda pl, ex, sg: ex)
+            notnull = self._bsi_rows(
+                field, shards, lambda pl, ex, sg: ex, ready=ready
+            )
             return notnull.difference(eq)
         if op == "><":
             lo, hi = cond.int_pair()
@@ -1344,6 +1353,7 @@ class Executor:
                 lambda pl, ex, sg: bsi.range_between(
                     pl, ex, sg, lo=lo - base, hi=hi - base, depth=depth
                 ),
+                ready=ready,
             )
         if op in ("<x<", "<=x<", "<x<=", "<=x<="):
             lo, hi = cond.int_pair()
@@ -1356,6 +1366,7 @@ class Executor:
                 lambda pl, ex, sg: bsi.range_between(
                     pl, ex, sg, lo=lo_incl - base, hi=hi_incl - base, depth=depth
                 ),
+                ready=ready,
             )
         raise ExecuteError(f"unsupported condition op: {op}")
 
@@ -1389,12 +1400,93 @@ class Executor:
         compute — a cache-served aggregate never pays it."""
         return bits[:, 0], bits[:, 1], bits[:, 2:]
 
-    def _bsi_rows(self, field: Field, shards: list[int], kernel) -> Row:
+    @staticmethod
+    def _host_cpu_device():
+        """The in-process CPU device for latency-tier kernel runs (the
+        CPU backend coexists with the accelerator backend), or None."""
+        try:
+            return jax.local_devices(backend="cpu")[0]
+        except Exception:
+            return None
+
+    # lone BSI predicates seen before the stack investment is judged
+    # worthwhile (the BSI twin of _PAIR_SINGLE_WARM; 0 = invest on the
+    # first query, i.e. the pre-round-4 behavior)
+    _BSI_SINGLE_WARM = 4
+
+    def _bsi_stack_live(self, field: Field, shards: list[int]) -> bool:
+        """Peek (never build): whether the field's BSI stack is cached
+        for these shards — the ONE place spelling the BSI stack key
+        shape, shared by the warm-up decision and the agg-cache gate."""
+        return self._stack_cached(
+            field, shards, field.bsi_view_name(), 2 + field.bit_depth
+        )
+
+    def _bsi_single_ready(self, field: Field, shards: list[int]) -> bool:
+        """Whether a LONE BSI predicate should take the device stack
+        path — mirror of _pair_single_ready's warm-up economics: a live
+        stack serves immediately; otherwise repeat demand must justify
+        the full-field device upload before a lone query pays it."""
+        if self._BSI_SINGLE_WARM <= 0:
+            return True
+        if self._bsi_stack_live(field, shards):
+            return True
+        lock = vars(field).setdefault("_stack_lock", threading.RLock())
+        with lock:
+            n = vars(field).get("_bsi_single_demand", 0) + 1
+            field._bsi_single_demand = n
+        return n >= self._BSI_SINGLE_WARM
+
+    def _bsi_rows(
+        self, field: Field, shards: list[int], kernel,
+        ready: bool | None = None,
+    ) -> Row:
         """Evaluate a BSI predicate kernel over every shard.  The kernels
         are shape-polymorphic (ops/bsi.py), so the stacked path runs the
         SAME compiled scan over [S, depth, W] in one launch; without a
-        stack (over budget) each fragment launches separately."""
+        stack (over budget) each fragment launches separately.
+
+        Latency tier: a LONE COLD predicate (no live stack, warm-up not
+        reached) runs the SAME kernel on the in-process CPU backend over
+        the fragment host mirrors — one compile per shape, then pure
+        host execution, no device upload (the BSI twin of the host
+        pair-count tier)."""
         out = Row(n_words=self.holder.n_words)
+        if ready is None:
+            ready = self._bsi_single_ready(field, shards)
+        cpu = self._host_cpu_device()
+        if cpu is not None and not ready:
+            view = field.view(field.bsi_view_name())
+            if view is None:
+                return out
+            frags = [
+                (s, view.fragment(s))
+                for s in shards
+                if view.fragment(s) is not None
+            ]
+            if not frags:
+                return out
+            depth = field.bit_depth
+            # ONE preallocated stacked buffer filled in place: the cold
+            # query costs exactly one field-sized host copy, not three
+            W = field.n_words
+            planes = np.zeros((len(frags), depth, W), dtype=np.uint32)
+            exists = np.zeros((len(frags), W), dtype=np.uint32)
+            sign = np.zeros((len(frags), W), dtype=np.uint32)
+            for si, (_, f) in enumerate(frags):
+                f.fill_bsi_tensors_host(
+                    depth, planes[si], exists[si], sign[si]
+                )
+            with jax.default_device(cpu):
+                mask = np.asarray(
+                    kernel(
+                        jnp.asarray(planes), jnp.asarray(exists),
+                        jnp.asarray(sign),
+                    )
+                )
+            for si, (s, _) in enumerate(frags):
+                out.segments[s] = mask[si]
+            return out
         st = self._bsi_stack(field, shards)
         if st is not None:
             exists, sign, planes = self._bsi_split(st)
@@ -1448,7 +1540,13 @@ class Executor:
         keyed = self._range_count_key(idx, child)
         if keyed is not None:
             field, key = keyed
-            bits = self._bsi_stack(field, shard_list)
+            # peek, never build: a lone cold range count must not pay a
+            # full-field device upload for the agg cache (the host BSI
+            # tier below answers it; repeat demand builds the stack)
+            ready = self._BSI_SINGLE_WARM <= 0 or self._bsi_stack_live(
+                field, shard_list
+            )
+            bits = self._bsi_stack(field, shard_list) if ready else None
             if bits is not None:
                 cached, put = self._bsi_agg_cache(field, bits, key)
                 if cached is not None:

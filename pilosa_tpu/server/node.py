@@ -189,8 +189,14 @@ class NodeServer:
         RESIZING/STARTING skip.  Idempotent; stop() ends it."""
         from pilosa_tpu.cluster.antientropy import AntiEntropyLoop
 
-        if interval <= 0 or self._ae_loop is not None:
+        if interval <= 0:
             return
+        old = self._ae_loop
+        if old is not None:
+            if old._thread is not None and old._thread.is_alive():
+                return  # already running (or a stopped pass still
+                # draining — must not overlap two passes)
+            self._ae_loop = None  # fully exited: re-arm below
         self._ae_loop = AntiEntropyLoop(
             self.syncer(), interval, state_fn=lambda: self.api.state
         )

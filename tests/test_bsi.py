@@ -193,6 +193,10 @@ class TestBSIStacks:
             "v", FieldOptions(field_type="int", min_=-1000, max_=1000)
         )
         ex = Executor(h)
+        # these tests assert STACKED serving (launch counters / agg
+        # caches); pin the BSI warm-up off so the stack engages on the
+        # first lone query (the host latency tier has its own tests)
+        ex._BSI_SINGLE_WARM = 0
         rng = np.random.default_rng(17)
         self.vals = {}
         width = h.n_words * 32
@@ -367,6 +371,7 @@ class TestRangeCountServing:
             "v", FieldOptions(field_type="int", min_=-300, max_=300)
         )
         ex = Executor(h)
+        ex._BSI_SINGLE_WARM = 0  # assert stacked serving from query 1
         rng = np.random.default_rng(31)
         self.vals = {}
         width = h.n_words * 32

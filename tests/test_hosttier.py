@@ -174,3 +174,72 @@ class TestExecutorHostTier:
             ex.execute("i", f"Set({c}, f=1) Set({c}, v={val})")
         got = ex.execute("i", "Count(Intersect(Row(f=1), Row(v < 600)))")[0]
         assert got == 2
+
+
+class TestBSIHostTier:
+    """Lone cold BSI predicates run the SAME ops/bsi kernels on the
+    in-process CPU backend over the fragment host mirrors (no device
+    stack upload); repeat demand crosses _BSI_SINGLE_WARM and promotes
+    to the stacked device path with identical answers."""
+
+    @pytest.fixture()
+    def exv(self):
+        from pilosa_tpu.core.field import FieldOptions
+
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field(
+            "v", FieldOptions(field_type="int", min_=-500, max_=500)
+        )
+        ex = Executor(h)
+        rng = np.random.default_rng(23)
+        vals = {}
+        width = h.n_words * 32
+        writes = []
+        for col in rng.choice(3 * width, size=180, replace=False):
+            v = int(rng.integers(-500, 500))
+            vals[int(col)] = v
+            writes.append(f"Set({int(col)}, v={v})")
+        ex.execute("i", " ".join(writes))
+        return ex, vals
+
+    def test_cold_predicates_exact_without_stack(self, exv):
+        ex, vals = exv
+        field = ex.holder.index("i").field("v")
+        checks = [
+            ("Row(v < 100)", {c for c, v in vals.items() if v < 100}),
+            ("Row(v >= -50)", {c for c, v in vals.items() if v >= -50}),
+            ("Row(v == 7)", {c for c, v in vals.items() if v == 7}),
+            ("Row(v != 7)", {c for c, v in vals.items() if v != 7}),
+            ("Row(-10 < v < 60)", {c for c, v in vals.items() if -10 < v < 60}),
+        ]
+        # the Nth lone query crosses the warm threshold, so only the
+        # first N-1 are guaranteed cold
+        for q, want in checks[: ex._BSI_SINGLE_WARM - 1]:
+            got = set(ex.execute("i", q)[0].columns().tolist())
+            assert got == want, q
+        # the cold queries above must NOT have built the device stack
+        assert not ex._bsi_stack_live(
+            field, ex._shards_for(ex.holder.index("i"), None)
+        )
+
+    def test_warm_promotion_matches_host_answers(self, exv):
+        ex, vals = exv
+        q = "Count(Row(v < 0))"
+        want = sum(1 for v in vals.values() if v < 0)
+        # cold host-tier answers, then past the threshold the stacked
+        # device path takes over — same result throughout
+        for _ in range(ex._BSI_SINGLE_WARM + 3):
+            assert ex.execute("i", q)[0] == want
+        field = ex.holder.index("i").field("v")
+        assert ex._bsi_stack_live(
+            field, ex._shards_for(ex.holder.index("i"), None)
+        )
+
+    def test_write_between_cold_predicates_is_visible(self, exv):
+        ex, vals = exv
+        q = "Count(Row(v > 400))"
+        before = ex.execute("i", q)[0]
+        free = max(vals) + 17
+        ex.execute("i", f"Set({free}, v=450)")
+        assert ex.execute("i", q)[0] == before + 1
