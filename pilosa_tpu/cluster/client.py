@@ -9,8 +9,11 @@ the reference's protobuf codec.
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any
 
@@ -23,6 +26,100 @@ class ClientError(Exception):
     def __init__(self, msg: str, code: int = 0):
         super().__init__(msg)
         self.code = code
+
+
+class _ConnPool:
+    """Keep-alive connection pool per (scheme, host:port).
+
+    urllib opens a fresh TCP connection per request, so every
+    node↔node call paid connection setup (plus a TLS handshake on
+    https clusters); the serving HTTP stack speaks HTTP/1.1 with
+    persistent connections, so pooled ``http.client`` connections cut
+    the per-call floor the way the reference's ``http.Transport``
+    connection reuse does (reference http/client.go uses Go's pooled
+    default transport)."""
+
+    MAX_IDLE_PER_HOST = 8
+
+    def __init__(self, timeout: float, ssl_ctx):
+        self._timeout = timeout
+        self._ssl_ctx = ssl_ctx
+        self._idle: dict[tuple[str, str], list] = {}
+        self._lock = threading.Lock()
+
+    def _new_conn(self, scheme: str, netloc: str):
+        if scheme == "https":
+            import ssl
+
+            ctx = self._ssl_ctx
+            if ctx is None:
+                ctx = ssl.create_default_context()
+            conn = http.client.HTTPSConnection(
+                netloc, timeout=self._timeout, context=ctx
+            )
+        else:
+            conn = http.client.HTTPConnection(netloc, timeout=self._timeout)
+        # TCP_NODELAY: without it, Nagle + delayed-ACK adds ~40 ms to
+        # every small request/response pair on a reused connection
+        conn.connect()
+        import socket
+
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def _checkout(self, key):
+        with self._lock:
+            conns = self._idle.get(key)
+            if conns:
+                return conns.pop()
+        return None
+
+    def _checkin(self, key, conn) -> None:
+        with self._lock:
+            conns = self._idle.setdefault(key, [])
+            if len(conns) < self.MAX_IDLE_PER_HOST:
+                conns.append(conn)
+                return
+        conn.close()
+
+    def request(
+        self, method: str, url: str, body: bytes | None, headers: dict
+    ) -> tuple[int, bytes, str]:
+        """(status, body, content-type); raises OSError-family on
+        transport failure after one retry on a stale pooled
+        connection."""
+        parts = urllib.parse.urlsplit(url)
+        key = (parts.scheme, parts.netloc)
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        # a pooled connection may have been closed by the server's
+        # keep-alive timeout: retry ONCE on a fresh connection, but only
+        # when the stale candidate came from the pool
+        pooled = self._checkout(key)
+        for attempt, conn in enumerate(
+            (pooled, None) if pooled is not None else (None,)
+        ):
+            fresh = conn is None
+            if fresh:
+                conn = self._new_conn(parts.scheme, parts.netloc)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                if fresh:
+                    raise
+                continue  # stale pooled connection; retry fresh
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(key, conn)
+            return (
+                resp.status,
+                data,
+                resp.headers.get("Content-Type") or "",
+            )
+        raise ClientError("connection retry logic exhausted")  # unreachable
 
 
 class InternalClient:
@@ -48,6 +145,7 @@ class InternalClient:
             import ssl
 
             self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+        self._pool = _ConnPool(timeout, self._ssl_ctx)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -61,31 +159,26 @@ class InternalClient:
         accept: str | None = None,
     ) -> tuple[bytes, str]:
         """(body, response content-type)."""
-        req = urllib.request.Request(
-            uri.rstrip("/") + path, data=body, method=method
-        )
+        headers: dict = {}
         if body is not None:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         if accept is not None:
-            req.add_header("Accept", accept)
+            headers["Accept"] = accept
         # Propagate the active trace across the node boundary (reference
         # tracing/opentracing.go:58-66 InjectHTTPHeaders).
         span = tracing.active_span()
         if span is not None:
-            hdrs: dict = {}
-            tracing.get_tracer().inject_headers(span.context, hdrs)
-            for k, v in hdrs.items():
-                req.add_header(k, v)
+            tracing.get_tracer().inject_headers(span.context, headers)
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
-            ) as resp:
-                return resp.read(), resp.headers.get("Content-Type") or ""
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise ClientError(f"{method} {path}: {e.code} {detail}", e.code) from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            status, data, ctype = self._pool.request(
+                method, uri.rstrip("/") + path, body, headers
+            )
+        except (http.client.HTTPException, OSError, TimeoutError) as e:
             raise ClientError(f"{method} {path}: {e}") from e
+        if status >= 400:
+            detail = data.decode(errors="replace")[:500]
+            raise ClientError(f"{method} {path}: {status} {detail}", status)
+        return data, ctype
 
     def _do(
         self,

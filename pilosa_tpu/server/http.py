@@ -84,6 +84,11 @@ class Handler(BaseHTTPRequestHandler):
     api: API = None  # set by make_server
     long_query_time: float = 0.0
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY on accepted sockets (socketserver applies this in
+    # StreamRequestHandler.setup): with keep-alive connections (the
+    # pooled internal client), Nagle + the peer's delayed ACK would add
+    # ~40 ms to every small response
+    disable_nagle_algorithm = True
 
     # -- plumbing -----------------------------------------------------------
 
