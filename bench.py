@@ -244,16 +244,87 @@ def main() -> None:
             sub[s] = words
     ex_seq = _Executor(h_seq)
     ex_seq._PAIR_SINGLE_WARM = 10**9  # keep every query cold
-    n_seq = 30
-    q0 = f"Count(Intersect(Row(f={int(ras[0])}), Row(f={int(rbs[0])})))"
-    ex_seq.execute("seq", q0)  # build native lib / warm code paths once
+    # 30 timed pairs off one permutation: every row appears at most
+    # once across the whole timed loop (60 of R=64 rows), so no query
+    # finds its operands in LLC from an earlier one — the same
+    # cache-cold footing the CPU baseline below is held to.  The warm
+    # call and its ground-truth check use the 2 leftover rows, touching
+    # nothing the timed loop reads.
+    seq_perm = np.random.default_rng(29).permutation(R)
+    # every timed pair distinct on BOTH configs: 30 pairs fit R=64's 62
+    # non-warm rows; the CPU-CI shape (R=32) gets 15
+    n_seq = min(30, (R - 2) // 2)
+    wa, wb = int(seq_perm[-2]), int(seq_perm[-1])
+    q0 = f"Count(Intersect(Row(f={wa}), Row(f={wb})))"
+    got0 = ex_seq.execute("seq", q0)[0]  # build native lib / warm once
+    # end-to-end by construction: the result must equal ground truth
+    # computed straight from the fragment host mirrors (any cache- or
+    # stub-serving regression fails loudly instead of flattering qps)
+    _want0 = 0
+    for _s in range(S):
+        _fr = v_seq.fragment(_s)
+        _want0 += int(
+            np.bitwise_count(
+                _fr.row_words_host(wa) & _fr.row_words_host(wb)
+            ).sum(dtype=np.uint64)
+        )
+    if got0 != _want0:
+        raise RuntimeError(f"cold path wrong: {got0} != {_want0}")
+    seq_lat = []
+    for i in range(n_seq):
+        qa, qb = int(seq_perm[2 * i]), int(seq_perm[2 * i + 1])
+        t0 = time.perf_counter()
+        ex_seq.execute(
+            "seq", f"Count(Intersect(Row(f={qa}), Row(f={qb})))"
+        )
+        seq_lat.append(time.perf_counter() - t0)
+    seq_lat.sort()
+    # qps from the MEDIAN query: every query does identical work (2 rows
+    # x S shards, distinct row pairs), so spread comes from the host —
+    # scheduler quota throttling in sandboxed runs inflates the MEAN by
+    # parking the process mid-burst (r03/r04 driver runs recorded 3-5x
+    # the manual numbers this way).  Median is robust to those parks yet
+    # still a full end-to-end Executor.execute round trip; min/mean/p90
+    # are all recorded below so nothing hides.
+    seq_qps = 1.0 / seq_lat[n_seq // 2]
+    # per-phase breakdown of the same cold path (VERDICT r04 ask):
+    # parse alone, then the fused native fan alone (addresses
+    # precomputed), so the recorded JSON shows where a slow run's time
+    # went without rerunning anything by hand.
+    from pilosa_tpu.ops import _hostops as _ho
+    from pilosa_tpu.pql import parser as _pql_parser
+
+    # same pair schedule as the timed loop (cold-for-cold: a different
+    # schedule could ride LLC-warm repeated rows and read lower than the
+    # loop it decomposes); trailing space dodges the parse cache so
+    # parse_ms measures real parses
     t0 = time.perf_counter()
     for i in range(n_seq):
-        ex_seq.execute(
-            "seq",
-            f"Count(Intersect(Row(f={int(ras[i % B])}), Row(f={int(rbs[i % B])})))",
+        _pql_parser.parse(
+            f"Count(Intersect(Row(f={int(seq_perm[2 * i])}),"
+            f" Row(f={int(seq_perm[2 * i + 1])}))) "
         )
-    seq_qps = n_seq / (time.perf_counter() - t0)
+    parse_ms = (time.perf_counter() - t0) / n_seq * 1e3
+    _view0 = idx_seq.field("f").view(VIEW_STANDARD)
+    t0 = time.perf_counter()
+    for i in range(n_seq):
+        ex_seq._host_pair_count(
+            _view0, int(seq_perm[2 * i]), int(seq_perm[2 * i + 1]),
+            "intersect", list(range(S)),
+        )
+    host_fan_ms = (time.perf_counter() - t0) / n_seq * 1e3
+    seq_breakdown = {
+        "native_hostops": _ho.load() is not None,
+        "cpu_count": os.cpu_count(),
+        "bytes_per_query": S * 2 * W * 4,
+        "parse_ms": round(parse_ms, 3),
+        "host_fan_ms": round(host_fan_ms, 3),
+        "lat_min_ms": round(seq_lat[0] * 1e3, 2),
+        "lat_p50_ms": round(seq_lat[n_seq // 2] * 1e3, 2),
+        "lat_mean_ms": round(sum(seq_lat) / n_seq * 1e3, 2),
+        "lat_p90_ms": round(seq_lat[-(-9 * n_seq // 10) - 1] * 1e3, 2),
+        "lat_max_ms": round(seq_lat[-1] * 1e3, 2),
+    }
 
     # -- cache-served sequential: the executor's steady-state for repeat
     # singles, measured as FULL Executor.execute round trips (parse
@@ -304,10 +375,24 @@ def main() -> None:
     # copy, so bytes-moved == index size and the GB/s figure is honest)
     scan = jax.jit(kernels.row_counts_per_shard_xla)
     _sync(scan(bits))
+    # relay round trip: the fixed cost every pull pays in this
+    # environment (~25-120 ms); recorded so launch-bound numbers are
+    # attributable (r04's 78 GB/s scan was 6 launches amortizing one
+    # ~64 ms RTT — re-measured at 24 launches the kernel streams
+    # ~297 GB/s, see ops/kernels.py header)
+    tiny = jax.jit(lambda: jnp.zeros((8,), jnp.uint32))
+    _sync(tiny())
+    rtts = []
+    for _ in range(3):  # best-of, same discipline as every latency figure
+        t0 = time.perf_counter()
+        _sync(tiny())
+        rtts.append(time.perf_counter() - t0)
+    relay_rtt_ms = min(rtts) * 1e3
+    n_scan = 24
     t0 = time.perf_counter()
-    outs = [scan(bits) for _ in range(6)]
+    outs = [scan(bits) for _ in range(n_scan)]
     _sync(outs[-1])
-    scan_t = (time.perf_counter() - t0) / 6
+    scan_t = (time.perf_counter() - t0) / n_scan
     scan_gbps = (n_bits / 8) / scan_t / 1e9
 
     # -- BSI range (BASELINE config 3: int-field Range + count) -------------
@@ -512,11 +597,16 @@ def main() -> None:
     # (same shape/density as the device tensor), so the baseline and the
     # host latency tier run against identical data.
     S_sub = sub_shards
-    qa, qb = int(ras[0]), int(rbs[0])
     # per-query: AND + popcount of two rows across all shards; best-of-5
-    # (wall clock on a shared host is noisy upward, never downward)
+    # over pairs drawn from a PERMUTATION so no row repeats across reps
+    # (caches hold rows, not pairs: a re-read row would serve from
+    # L2/L3 and flatter the baseline — the real index streams from
+    # DRAM, and the measured path above is charged that way); min
+    # because wall clock on a shared host is noisy upward, never down
+    perm = np.random.default_rng(23).permutation(R)
     times = []
-    for _ in range(5):
+    for k in range(5):
+        qa, qb = int(perm[2 * k]), int(perm[2 * k + 1])
         t0 = time.perf_counter()
         int(np.bitwise_count(sub[:, qa] & sub[:, qb]).sum())
         times.append(time.perf_counter() - t0)
@@ -561,6 +651,8 @@ def main() -> None:
         "cpu_qps_per_gbit": round(cpu_qps / (n_bits / 1e9), 2),
         "batch_size": B,
         "batched_checksum": checksum,
+        "seq_breakdown": seq_breakdown,
+        "relay_rtt_ms": round(relay_rtt_ms, 1),
         **{k: round(v, 3) for k, v in serving.items()},
         "probe": _PROBE_ATTEMPTS,
     }

@@ -18,12 +18,15 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
   into one index read, and the Pallas variant keeps the 32x int8
   expansion in VMEM instead of HBM.
 * **Fused XLA scans** for per-row popcounts (TopN) and everything else:
-  measured ~107 GB/s on v5e at the 10.7e9-bit shape, and every
-  alternative plateaus there too (hand-blocked Pallas staging at
-  several tile sizes, and MXU dot-reduce of the popcount bytes all
-  measure 103-107 GB/s) — the bound is the VPU popcount+accumulate
-  rate (~27 G words/s), not HBM or scheduling, so XLA's fusion is
-  already at the op's hardware ceiling and Pallas is OFF by default
+  measured ~297 GB/s on v5e at the 10.7e9-bit shape once the relay
+  round trip is amortized over 24 pipelined launches (bench.py r05).
+  Earlier rounds reported 103-107 GB/s and called it a VPU popcount
+  ceiling — that figure was 6-or-fewer launches absorbing a ~64 ms
+  relay RTT into the per-launch average, not a kernel property; the
+  corrected number sits at ~36% of v5e's 819 GB/s HBM stream, so the
+  scan is HBM/fusion-bound, with headroom that doesn't matter
+  architecturally (see maintained counts below).  Pallas row-scan
+  variants measured at parity, so they stay OFF by default
   (``PILOSA_TPU_PALLAS=1`` re-enables the row-scan kernels for
   hardware where the balance differs; they compile on real TPU —
   (8-shard, full-row, word-block) tiles — and validate under interpret

@@ -1,0 +1,82 @@
+"""Same cold-path measurement as prof_cold.py but with the accelerator
+platform ACTIVE and a device-resident index — reproducing the driver
+bench environment, where BENCH_r04 recorded 26 ms/query against 4.9 ms
+on the plain CPU platform."""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pilosa_tpu.ops import kernels
+
+print("platform:", jax.devices()[0].platform)
+
+S, R, W = 160, 64, 32768
+key = jax.random.PRNGKey(7)
+k1, k2 = jax.random.split(key)
+bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
+    k2, (S, R, W), dtype=jnp.uint32
+)
+np.asarray(bits[0, 0, :4])  # sync
+
+# one gram launch + pull, like the batched section leaves behind
+gram = jax.jit(lambda b: kernels.gram_matrix_traced(b))
+g = np.asarray(gram(bits))
+print("gram pulled", g.shape)
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec.executor import Executor
+
+rng = np.random.default_rng(3)
+B = 64
+ras = rng.integers(0, R, size=B).astype(np.int64)
+rbs = rng.integers(0, R, size=B).astype(np.int64)
+
+h = Holder(n_words=W)
+idx = h.create_index("seq")
+f = idx.create_field("f")
+v = f.create_view_if_not_exists(VIEW_STANDARD)
+seq_rng = np.random.default_rng(13)
+for s in range(S):
+    words = seq_rng.integers(0, 2**32, size=(R, W), dtype=np.uint32) & \
+        seq_rng.integers(0, 2**32, size=(R, W), dtype=np.uint32)
+    frag = v.create_fragment_if_not_exists(s)
+    for r in range(R):
+        frag.set_row_words(r, words[r])
+
+ex = Executor(h)
+ex._PAIR_SINGLE_WARM = 10**9
+q0 = f"Count(Intersect(Row(f={int(ras[0])}), Row(f={int(rbs[0])})))"
+ex.execute("seq", q0)
+
+n_seq = 30
+t0 = time.perf_counter()
+per = []
+for i in range(n_seq):
+    t1 = time.perf_counter()
+    ex.execute(
+        "seq",
+        f"Count(Intersect(Row(f={int(ras[i % B])}), Row(f={int(rbs[i % B])})))",
+    )
+    per.append(time.perf_counter() - t1)
+dt = time.perf_counter() - t0
+print(f"cold execute: {dt/n_seq*1e3:.2f} ms/q  ({n_seq/dt:.1f} qps)")
+print("per-query ms:", [round(p * 1e3, 1) for p in per])
+
+# numpy baseline, same as bench.py (cache-hot best-of-5, scaled)
+frags = [v.fragment(s) for s in range(10)]
+qa, qb = int(ras[0]), int(rbs[0])
+suba = np.stack([fr._host[fr._slot_of[qa]] for fr in frags])
+subb = np.stack([fr._host[fr._slot_of[qb]] for fr in frags])
+times = []
+for _ in range(5):
+    t1 = time.perf_counter()
+    int(np.bitwise_count(suba & subb).sum())
+    times.append(time.perf_counter() - t1)
+print(f"numpy baseline (scaled x16, best of 5): {min(times)*16*1e3:.2f} ms/q")

@@ -1,0 +1,86 @@
+"""Debug the 27ms-vs-5ms cold execute gap seen in bench.py: replicate
+the bench's exact pre-state (device gram section first), then time the
+cold loop unsorted and cProfile it."""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pilosa_tpu.ops import kernels
+
+print("platform:", jax.devices()[0].platform, flush=True)
+
+S, R, W = 160, 64, 32768
+key = jax.random.PRNGKey(7)
+k1, k2 = jax.random.split(key)
+bits = jax.random.bits(k1, (S, R, W), dtype=jnp.uint32) & jax.random.bits(
+    k2, (S, R, W), dtype=jnp.uint32
+)
+np.asarray(bits[0, 0, :4])
+
+rng = np.random.default_rng(3)
+B = 1024
+ras = rng.integers(0, R, size=B).astype(np.int64)
+rbs = rng.integers(0, R, size=B).astype(np.int64)
+
+# exact bench pre-state: salted gram launches + stacked pull
+gram_salted = jax.jit(lambda b, s: kernels.gram_matrix_traced(b ^ s))
+salts = [jnp.uint32(i) for i in range(9)]
+reps = 4
+np.asarray(jnp.stack([gram_salted(bits, salts[-1]) for _ in range(reps)]))
+grams = [gram_salted(bits, salts[r]) for r in range(reps)]
+grams_np = np.asarray(jnp.stack(grams)).astype(np.int64)
+counts = [kernels.pair_counts_from_gram(g, ras, rbs, "intersect") for g in grams_np]
+print("gram section done", flush=True)
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec.executor import Executor
+
+h = Holder(n_words=W)
+idx = h.create_index("seq")
+f = idx.create_field("f")
+v = f.create_view_if_not_exists(VIEW_STANDARD)
+seq_rng = np.random.default_rng(13)
+sub_shards = max(1, S // 16)
+for s in range(S):
+    words = seq_rng.integers(0, 2**32, size=(R, W), dtype=np.uint32) & \
+        seq_rng.integers(0, 2**32, size=(R, W), dtype=np.uint32)
+    frag = v.create_fragment_if_not_exists(s)
+    for r in range(R):
+        frag.set_row_words(r, words[r])
+print("setup done", flush=True)
+
+ex = Executor(h)
+ex._PAIR_SINGLE_WARM = 10**9
+q0 = f"Count(Intersect(Row(f={int(ras[0])}), Row(f={int(rbs[0])})))"
+ex.execute("seq", q0)
+
+n_seq = 30
+lat = []
+for i in range(n_seq):
+    t1 = time.perf_counter()
+    ex.execute(
+        "seq",
+        f"Count(Intersect(Row(f={int(ras[i % B])}), Row(f={int(rbs[i % B])})))",
+    )
+    lat.append(time.perf_counter() - t1)
+print("unsorted ms:", [round(p * 1e3, 1) for p in lat], flush=True)
+
+import cProfile
+import pstats
+
+pr = cProfile.Profile()
+pr.enable()
+for i in range(n_seq):
+    ex.execute(
+        "seq",
+        f"Count(Intersect(Row(f={int(ras[i % B])}), Row(f={int(rbs[i % B])})))",
+    )
+pr.disable()
+pstats.Stats(pr).sort_stats("tottime").print_stats(18)
