@@ -92,6 +92,23 @@ def _ensure_backend() -> None:
         jax.devices()
 
 
+def _parse_statsd_host(raw: str) -> tuple[str, int]:
+    """(host, port) from a statsd ``host`` config value.  Accepts
+    "host:8125", "host" (default port), "[::1]:8125", "[::1]", and a
+    bare IPv6 literal "::1" (which a naive rpartition would mangle
+    into host ":" port 1)."""
+    if raw.startswith("["):
+        host, _, rest = raw[1:].partition("]")
+        port = rest[1:] if rest.startswith(":") else "8125"
+    elif raw.count(":") == 1:
+        host, _, port = raw.partition(":")
+    else:
+        host, port = raw, "8125"
+    if not port.isdigit():
+        port = "8125"
+    return host or "127.0.0.1", int(port)
+
+
 def cmd_server(args) -> int:
     _ensure_backend()
     from pilosa_tpu.obs.stats import MemStatsClient, NOP
@@ -126,13 +143,10 @@ def cmd_server(args) -> int:
     elif service in ("statsd", "datadog"):
         from pilosa_tpu.obs.stats import StatsDClient
 
-        raw = metric_cfg.get("host", "127.0.0.1:8125")
-        mhost, _, mport = raw.rpartition(":")
-        if not mhost or not mport.isdigit():
-            # portless host ("statsd.local") or IPv6 literal: treat the
-            # whole value as the host, default the port
-            mhost, mport = raw, "8125"
-        stats_client = StatsDClient(mhost or "127.0.0.1", int(mport))
+        mhost, mport = _parse_statsd_host(
+            metric_cfg.get("host", "127.0.0.1:8125")
+        )
+        stats_client = StatsDClient(mhost, mport)
     else:  # expvar / prometheus: in-memory client served over HTTP
         stats_client = MemStatsClient()
     tls_cfg = cfg.get("tls", {})

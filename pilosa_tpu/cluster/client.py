@@ -83,11 +83,19 @@ class _ConnPool:
         conn.close()
 
     def request(
-        self, method: str, url: str, body: bytes | None, headers: dict
+        self,
+        method: str,
+        url: str,
+        body: bytes | None,
+        headers: dict,
+        idempotent: bool = True,
     ) -> tuple[int, bytes, str]:
         """(status, body, content-type); raises OSError-family on
         transport failure after one retry on a stale pooled
-        connection."""
+        connection.  ``idempotent=False`` restricts that retry to
+        failures during the SEND phase: once the request has been
+        handed to the kernel, the server may have executed it, and
+        replaying a non-idempotent request could double-apply it."""
         parts = urllib.parse.urlsplit(url)
         key = (parts.scheme, parts.netloc)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
@@ -101,13 +109,15 @@ class _ConnPool:
             fresh = conn is None
             if fresh:
                 conn = self._new_conn(parts.scheme, parts.netloc)
+            sent = False
             try:
                 conn.request(method, path, body=body, headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, OSError):
                 conn.close()
-                if fresh:
+                if fresh or (sent and not idempotent):
                     raise
                 continue  # stale pooled connection; retry fresh
             if resp.will_close:
@@ -157,8 +167,16 @@ class InternalClient:
         body: bytes | None = None,
         content_type: str = "application/json",
         accept: str | None = None,
+        idempotent: bool = True,
     ) -> tuple[bytes, str]:
-        """(body, response content-type)."""
+        """(body, response content-type).
+
+        ``idempotent`` defaults True because every internal endpoint
+        today is a merge or find-or-create (imports union bits, schema
+        ops are create-if-absent, translate appends are keyed by name,
+        resize ops are target-state): replaying any of them is safe.  A
+        FUTURE endpoint with execute-once semantics must pass False so
+        the pool won't replay it after a stale-connection failure."""
         headers: dict = {}
         if body is not None:
             headers["Content-Type"] = content_type
@@ -171,7 +189,11 @@ class InternalClient:
             tracing.get_tracer().inject_headers(span.context, headers)
         try:
             status, data, ctype = self._pool.request(
-                method, uri.rstrip("/") + path, body, headers
+                method,
+                uri.rstrip("/") + path,
+                body,
+                headers,
+                idempotent=idempotent,
             )
         except (http.client.HTTPException, OSError, TimeoutError) as e:
             raise ClientError(f"{method} {path}: {e}") from e

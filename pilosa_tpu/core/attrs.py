@@ -150,16 +150,30 @@ class AttrStore:
 
     # -- persistence --------------------------------------------------------
 
-    def drain_dirty(self) -> dict[int, dict[int, dict[str, Any]]]:
-        """{block_id: block data} for every block dirtied since the last
-        drain, clearing the dirty set — the storage layer writes exactly
-        these files (the reference's per-bucket BoltDB writes play the
-        same role, boltdb/attrstore.go:37-90)."""
+    def flush_dirty(self) -> None:
+        """Persist every block dirtied since the last flush through
+        ``self.backend.write_blocks({block_id: block_data})`` — the
+        storage layer writes exactly these files (the reference's
+        per-bucket BoltDB writes play the same role,
+        boltdb/attrstore.go:37-90).
+
+        The dirty set is cleared (and drained blocks become evictable)
+        only AFTER the writer returns: a failed write (disk full) leaves
+        every block dirty for the next flush instead of silently
+        dropping it.  The lock is held across the write so a concurrent
+        ``attrs()`` read cannot load-and-cache the stale on-disk block
+        mid-flush and keep serving it after the flush lands — attr
+        flushes are small (dirty blocks only) and attrs are never on
+        the query hot path, so blocking reads for the write is the
+        right trade."""
         with self._lock:
-            out = {bid: self.block_data(bid) for bid in self._dirty}
+            if self.backend is None or not self._dirty:
+                return
+            self.backend.write_blocks(
+                {bid: self.block_data(bid) for bid in self._dirty}
+            )
             self._dirty.clear()
             self._evict()
-            return out
 
     def to_dict(self) -> dict[str, dict[str, Any]]:
         with self._lock:

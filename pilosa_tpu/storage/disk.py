@@ -95,8 +95,15 @@ def _attach_attr_backend(store, dir_path: str, legacy_json: str) -> None:
     if os.path.exists(legacy_json):
         try:
             with open(legacy_json) as f:
-                store.load_dict(json.load(f))
-            store.backend.write_blocks(store.drain_dirty())
+                legacy = json.load(f)
+            # MERGE into backend-loaded blocks (set_attrs loads each
+            # block through the backend first): a legacy id landing in
+            # a block that already has a b<N>.json must not clobber the
+            # block's other ids
+            store.set_bulk_attrs(
+                {int(k): dict(v) for k, v in legacy.items()}
+            )
+            store.flush_dirty()
             os.unlink(legacy_json)
         except (OSError, ValueError):
             pass
@@ -299,7 +306,7 @@ class HolderStore:
         whole-store rewrite — reference boltdb writes per bucket)."""
         if store.backend is None:
             store.backend = AttrBlocksDir(dir_path)
-        store.backend.write_blocks(store.drain_dirty())
+        store.flush_dirty()
 
     def _detach_stores(self, match) -> None:
         """Close + drop FragmentFile stores whose fragment matches, so

@@ -159,3 +159,19 @@ def test_http_metrics_and_debug_vars(tmp_path):
         assert snap["serving_cache"]["gram_hits"] >= 1
     finally:
         node.stop()
+
+
+def test_parse_statsd_host_forms():
+    """IPv4/hostname/IPv6 statsd host parsing (ADVICE r4: "::1" was
+    mangled into host ":" port 1, bracketed forms kept brackets)."""
+    from pilosa_tpu.cli import _parse_statsd_host
+
+    assert _parse_statsd_host("10.0.0.9:9125") == ("10.0.0.9", 9125)
+    assert _parse_statsd_host("statsd.local") == ("statsd.local", 8125)
+    assert _parse_statsd_host("statsd.local:77") == ("statsd.local", 77)
+    assert _parse_statsd_host("::1") == ("::1", 8125)
+    assert _parse_statsd_host("2001:db8::2") == ("2001:db8::2", 8125)
+    assert _parse_statsd_host("[::1]:9125") == ("::1", 9125)
+    assert _parse_statsd_host("[2001:db8::2]") == ("2001:db8::2", 8125)
+    assert _parse_statsd_host("") == ("127.0.0.1", 8125)
+    assert _parse_statsd_host("host:notaport") == ("host", 8125)

@@ -466,7 +466,58 @@ class TestAttrBlockPersistence:
         assert not os.path.exists(os.path.join(d, "i", ".attrs.json"))
         assert idx2.column_attrs.attrs(42) == {"legacy": True}
         assert h2.field("i", "f").row_attrs.attrs(9) == {"kind": "x"}
+        # ids 1 and 42 share block 0: migrating the legacy id must MERGE
+        # into the existing b0.json, not clobber id 1's attrs (ADVICE r4)
+        assert idx2.column_attrs.attrs(1) == {"city": "sfo"}
         store2.close()
+
+    def test_flush_dirty_failure_keeps_blocks_dirty(self):
+        """A failed write_blocks must leave the dirtied blocks dirty so
+        the NEXT flush persists them (ADVICE r4: drain-then-write lost
+        attrs forever when the write raised)."""
+        import pytest
+
+        from pilosa_tpu.core.attrs import ATTR_BLOCK_SIZE, AttrStore
+
+        class FlakyBackend:
+            def __init__(self):
+                self.blocks = {}
+                self.fail = True
+
+            def load_block(self, bid):
+                return self.blocks.get(bid)
+
+            def block_ids(self):
+                return list(self.blocks)
+
+            def write_blocks(self, blocks):
+                if self.fail:
+                    raise OSError("disk full")
+                self.blocks.update(
+                    {
+                        bid: {str(k): v for k, v in data.items()}
+                        for bid, data in blocks.items()
+                    }
+                )
+
+        be = FlakyBackend()
+        s = AttrStore(backend=be, cache_blocks=2)
+        s.set_attrs(5, {"a": 1})
+        s.set_attrs(3 * ATTR_BLOCK_SIZE, {"b": 2})
+        with pytest.raises(OSError):
+            s.flush_dirty()
+        assert be.blocks == {}  # nothing persisted...
+        assert s._dirty == {0, 3}  # ...and nothing forgotten
+        # reads during the failed window still serve the new values
+        assert s.attrs(5) == {"a": 1}
+        be.fail = False
+        s.flush_dirty()
+        assert s._dirty == set()
+        assert be.blocks[0]["5"] == {"a": 1}
+        assert be.blocks[3][str(3 * ATTR_BLOCK_SIZE)] == {"b": 2}
+        # flush with nothing dirty is a no-op (writer not called)
+        be.fail = True
+        s.flush_dirty()
 
     def test_lru_eviction_bounded_and_correct(self):
         from pilosa_tpu.core.attrs import ATTR_BLOCK_SIZE, AttrStore
@@ -481,13 +532,20 @@ class TestAttrBlockPersistence:
             def block_ids(self):
                 return list(self.blocks)
 
+            def write_blocks(self, blocks):
+                self.blocks.update(
+                    {
+                        bid: {str(k): v for k, v in data.items()}
+                        for bid, data in blocks.items()
+                    }
+                )
+
         be = MemBackend()
         s = AttrStore(backend=be, cache_blocks=4)
         for i in range(10):
             s.set_attrs(i * ATTR_BLOCK_SIZE, {"n": i})
         # flush everything to the backend; cache shrinks to the cap
-        for bid, data in s.drain_dirty().items():
-            be.blocks[bid] = {str(k): v for k, v in data.items()}
+        s.flush_dirty()
         assert len(s._blocks) <= 4
         # every id still readable (evicted blocks reload from backend)
         for i in range(10):
