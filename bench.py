@@ -527,6 +527,13 @@ def main() -> None:
             sl = slice(bi * batch, (bi + 1) * batch)
             frag2.import_bits(srows[sl], scols[sl])
         sq.await_all()  # snapshots are part of the steady-state cost
+        # durable-on-host rate: the comparison point for the reference
+        # anchor (the reference is CPU-only; our EXTRA device refresh
+        # below rides a 24 MB/s relay in this environment, which a
+        # production host's 100+ GB/s PCIe/ICI h2d does not resemble)
+        sustained_nodev_bits_s = (n_batches * batch) / (
+            time.perf_counter() - t0
+        )
         frag2.device_bits()  # converge the serving copy once
         sustained_bits_s = (n_batches * batch) / (time.perf_counter() - t0)
         sq.stop()
@@ -570,8 +577,11 @@ def main() -> None:
                     + zlib.crc32(payload).to_bytes(4, "little")
                     + payload
                 )
+                # reference durability: op appends are NOT fsynced
+                # (roaring.go:1655 writeOp) — only snapshot files are;
+                # the repo side now runs the same policy
+                # (PILOSA_TPU_WAL_FSYNC default "snapshot")
                 fh.flush()
-                os.fsync(fh.fileno())
                 ops_since_snap += len(positions)
                 if ops_since_snap > 10_000:  # MaxOpN snapshot rewrite
                     snap = os.path.join(d2, "anchor.snap")
@@ -591,6 +601,84 @@ def main() -> None:
             return (n_batches * batch) / (time.perf_counter() - t0)
 
     cpu_ingest_bits_s = _cpu_anchor_ingest(srows, scols, n_batches, batch, W)
+
+    # -- reference anchors (VERDICT r04 #2): the compiled C++ port of
+    # the reference's own semantic work (native/refanchor.cpp — roaring
+    # containers, AddN sorted-merge, per-row CountRange cache update,
+    # snapshot serialize+fsync; see tools/ref_anchor.py for the full
+    # benchmark-by-benchmark table) run on the SAME data as the repo
+    # paths above.  None when no toolchain exists in the sandbox.
+    ref_sustained_bits_s = None
+    ref_seq_qps = None
+    try:
+        from pilosa_tpu.ops import _refanchor
+
+        if _refanchor.load() is not None:
+            # sustained ingest: every batch's changed bits (~500k) trip
+            # MaxOpN=10000, so the reference pays a full snapshot per
+            # batch (fragment.go:2283-2293 incrementOpN -> snapshot)
+            width64 = np.uint64(W * 32)
+            with tempfile.TemporaryDirectory() as dr:
+                with _refanchor.RefBitmap() as rb:
+                    opw = open(os.path.join(dr, "ops"), "ab")
+                    t0 = time.perf_counter()
+                    for bi in range(n_batches):
+                        sl = slice(bi * batch, (bi + 1) * batch)
+                        pos = np.unique(
+                            srows[sl] * width64
+                            + scols[sl].astype(np.uint64)
+                        )
+                        rb.addn_sorted(pos)
+                        # the reference also appends an opTypeAddBatch
+                        # record per AddN (roaring.go:248-265, 8 bytes
+                        # per changed bit, page-cache only)
+                        opw.write(pos.tobytes())
+                        opw.flush()
+                        for r in np.unique(srows[sl]):
+                            rb.count_range(
+                                int(r) * W * 32, (int(r) + 1) * W * 32
+                            )
+                        rb.snapshot(os.path.join(dr, "snap"))
+                    ref_sustained_bits_s = (n_batches * batch) / (
+                        time.perf_counter() - t0
+                    )
+                    opw.close()
+            # sequential query: S pseudo-shards of the real row pair
+            # (25% density -> bitmap containers; one query walks the
+            # same ~42 MB the host tier streams), counted in ONE native
+            # crossing like the reference's in-process shard fan.  The
+            # host L3 is 260 MB, so the working set is explicitly
+            # EVICTED between reps — the repo's cold loop reads
+            # distinct rows of a 1.3 GB index and gets no cache help;
+            # the anchor must not either.
+            def _row_positions(words, row):
+                bits = np.unpackbits(
+                    words.view(np.uint8), bitorder="little"
+                )
+                return np.nonzero(bits)[0].astype(np.uint64) + np.uint64(
+                    row
+                ) * np.uint64(W * 32)
+
+            pos_a = _row_positions(sub[0, wa], 0)
+            pos_b = _row_positions(sub[0, wb], 1)
+            with _refanchor.RefBitmap() as rb:
+                for k in range(S):
+                    off = np.uint64(2 * k) * np.uint64(W * 32)
+                    rb.addn_sorted(pos_a + off)
+                    rb.addn_sorted(pos_b + off)
+                rows_a = np.arange(S, dtype=np.uint64) * 2
+                rows_b = rows_a + 1
+                evict = np.zeros(40 * 1024 * 1024, dtype=np.uint64)
+                ref_ts = []
+                for _ in range(3):
+                    evict[:] = 1  # 320 MB write pass flushes L3
+                    t0 = time.perf_counter()
+                    rb.intersection_count_many(rows_a, rows_b, W * 32)
+                    ref_ts.append(time.perf_counter() - t0)
+                del evict
+                ref_seq_qps = 1.0 / min(ref_ts)
+    except Exception as e:  # anchor must never sink the bench
+        print(f"warning: refanchor failed: {e}", file=sys.stderr)
 
     # -- CPU baseline (numpy popcount on a shard subset, scaled) ------------
     # ``sub`` is the host-generated shard subset of the sequential index
@@ -653,6 +741,24 @@ def main() -> None:
         "batched_checksum": checksum,
         "seq_breakdown": seq_breakdown,
         "relay_rtt_ms": round(relay_rtt_ms, 1),
+        # vs the compiled reference-anchor (same semantic work, same
+        # data; None when no C++ toolchain in the sandbox)
+        "refanchor_available": ref_sustained_bits_s is not None,
+        "sustained_ingest_nodevice_bits_s": round(sustained_nodev_bits_s, 0),
+        "sustained_ingest_vs_reference": (
+            round(sustained_nodev_bits_s / ref_sustained_bits_s, 2)
+            if ref_sustained_bits_s
+            else None
+        ),
+        "reference_sustained_bits_s": (
+            round(ref_sustained_bits_s, 0) if ref_sustained_bits_s else None
+        ),
+        "sequential_vs_reference": (
+            round(seq_qps / ref_seq_qps, 2) if ref_seq_qps else None
+        ),
+        "reference_seq_qps": (
+            round(ref_seq_qps, 1) if ref_seq_qps else None
+        ),
         **{k: round(v, 3) for k, v in serving.items()},
         "probe": _PROBE_ATTEMPTS,
     }

@@ -1,0 +1,380 @@
+"""Reference benchmark anchors: faithful ports of the reference's key
+Go benchmarks, timed against this repo's equivalent paths on the same
+host, same data.
+
+No Go toolchain exists in this image (BASELINE.md's preferred "run the
+reference's Go benchmarks" is impossible), so the named benchmarks are
+ported at two levels:
+
+* the ANCHOR side runs a compiled C++ port of the reference's data
+  structures and algorithms (native/refanchor.cpp: roaring
+  array/bitmap containers, AddN, CountRange, intersectionCount,
+  snapshot serialization+fsync) — conservative, i.e. at least as fast
+  as the Go original for this work (sorted-merge AddN vs per-position
+  btree seeks, no bounds checks, no GC);
+* the REPO side runs this framework's real code path for the same
+  semantic operation.
+
+Ported benchmarks (reference file:line):
+  intersection_count   fragment_internal_test.go:1432
+                       BenchmarkFragment_IntersectionCount
+  import_standard      fragment_internal_test.go:2166
+                       BenchmarkImportStandard (zipf 1.6/50 rows)
+  full_snapshot        fragment_internal_test.go:1964
+                       BenchmarkFragment_FullSnapshot
+  import_update        fragment_internal_test.go:2190
+                       BenchmarkImportRoaringUpdate (Rows1000Cols50000)
+
+Prints one JSON object and (with --baseline-md) rewrites the measured
+table in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHARD_WIDTH = 1 << 20  # the reference's default (shardwidth.go)
+
+
+def zipf_rows(rng: np.random.Generator, num_rows: int, n: int) -> np.ndarray:
+    """Row ids with P(k) proportional to 1/(50+k)^1.6 on [0, num_rows)
+    — the distribution of the reference's rand.NewZipf(r, 1.6, 50,
+    numRows-1) generators (fragment_internal_test.go:2377,2449)."""
+    w = 1.0 / np.power(50.0 + np.arange(num_rows, dtype=np.float64), 1.6)
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    return np.searchsorted(cdf, rng.random(n)).astype(np.uint64)
+
+
+def _best(f, reps: int) -> float:
+    """min-of-reps wall time (noise on a shared host is upward-only)."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def bench_intersection_count(results: dict) -> None:
+    """BenchmarkFragment_IntersectionCount: row 1 = every 2nd column of
+    10000, row 2 = every 3rd; time |row1 & row2| repeatedly."""
+    from pilosa_tpu.ops import _hostops, _refanchor
+
+    cols1 = np.arange(0, 10000, 2, dtype=np.uint64)
+    cols2 = np.arange(0, 10000, 3, dtype=np.uint64)
+
+    rb = _refanchor.RefBitmap()
+    rb.addn_sorted(1 * SHARD_WIDTH + cols1)
+    rb.addn_sorted(2 * SHARD_WIDTH + cols2)
+    want = int(np.intersect1d(cols1, cols2).size)
+    got = rb.intersection_count(1, 2, SHARD_WIDTH)
+    assert got == want, (got, want)
+    reps = 2000
+    anchor_t = (
+        _best(
+            lambda: [
+                rb.intersection_count(1, 2, SHARD_WIDTH) for _ in range(reps)
+            ],
+            5,
+        )
+        / reps
+    )
+    rb.close()
+
+    # repo: dense host-mirror rows + the host latency tier's fused
+    # native kernel — the same unit the executor's cold path runs per
+    # fragment (exec/executor.py _host_pair_count_chunk)
+    n_words = SHARD_WIDTH // 32
+    row1 = np.zeros(n_words, dtype=np.uint32)
+    row2 = np.zeros(n_words, dtype=np.uint32)
+    np.bitwise_or.at(
+        row1, cols1 // 32, np.uint32(1) << (cols1 % 32).astype(np.uint32)
+    )
+    np.bitwise_or.at(
+        row2, cols2 // 32, np.uint32(1) << (cols2 % 32).astype(np.uint32)
+    )
+    assert _hostops.pair_count(row1, row2, "intersect") == want
+    repo_t = (
+        _best(
+            lambda: [
+                _hostops.pair_count(row1, row2, "intersect")
+                for _ in range(reps)
+            ],
+            5,
+        )
+        / reps
+    )
+    results["intersection_count"] = {
+        "reference": "BenchmarkFragment_IntersectionCount "
+        "(fragment_internal_test.go:1432)",
+        "anchor_us": round(anchor_t * 1e6, 2),
+        "repo_us": round(repo_t * 1e6, 2),
+        "repo_vs_anchor": round(anchor_t / repo_t, 3),
+        "note": "anchor: array-x-bitmap container loop over ~3.3k "
+        "elements; repo: dense 2x128KB fused and+popcount — the dense "
+        "layout streams 77x the bytes for a sparse lone pair; the "
+        "framework serves repeats from the gram cache and batches on "
+        "the MXU instead (see serving_* in bench.py)",
+    }
+
+
+def bench_import_standard(results: dict) -> None:
+    """BenchmarkImportStandard: 2^20 (row, col) pairs, rows zipf over
+    {2, 1000, 100000} distinct rows, one bulk import into a fresh
+    fragment (no snapshot await — the reference enqueues it async)."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.ops import _refanchor
+
+    out = {}
+    for num_rows in (2, 1000, 100000):
+        rng = np.random.default_rng(1)
+        rows = zipf_rows(rng, num_rows, SHARD_WIDTH)
+        cols = np.arange(SHARD_WIDTH, dtype=np.uint64)
+
+        def anchor_once():
+            rb = _refanchor.RefBitmap()
+            pos = np.unique(rows * SHARD_WIDTH + cols)
+            rb.addn_sorted(pos)
+            # per-affected-row cache update (fragment.go:2085-2096)
+            for r in np.unique(rows):
+                rb.count_range(
+                    int(r) * SHARD_WIDTH, (int(r) + 1) * SHARD_WIDTH
+                )
+            rb.close()
+
+        def repo_once():
+            frag = Fragment(n_words=SHARD_WIDTH // 32)
+            frag.import_bits(rows.copy(), cols.copy())
+
+        anchor_t = _best(anchor_once, 3)
+        repo_t = _best(repo_once, 3)
+        out[f"rows{num_rows}"] = {
+            "anchor_mbits_s": round(SHARD_WIDTH / anchor_t / 1e6, 2),
+            "repo_mbits_s": round(SHARD_WIDTH / repo_t / 1e6, 2),
+            "repo_vs_anchor": round(anchor_t / repo_t, 3),
+        }
+    out["reference"] = (
+        "BenchmarkImportStandard (fragment_internal_test.go:2166)"
+    )
+    results["import_standard"] = out
+
+
+def bench_full_snapshot(results: dict) -> None:
+    """BenchmarkFragment_FullSnapshot: 100 rows x 2^19 bits (every 2nd
+    column), snapshot (serialize + fsync) repeatedly."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.storage.fragmentfile import FragmentFile, SnapshotQueue
+    from pilosa_tpu.ops import _refanchor
+
+    cols = np.arange(1, SHARD_WIDTH, 2, dtype=np.uint64)
+    rb = _refanchor.RefBitmap()
+    for r in range(100):
+        rb.addn_sorted(r * SHARD_WIDTH + cols)
+
+    rows_all = np.repeat(np.arange(100, dtype=np.uint64), cols.size)
+    cols_all = np.tile(cols, 100)
+
+    with tempfile.TemporaryDirectory() as d:
+        anchor_t = _best(
+            lambda: rb.snapshot(os.path.join(d, "anchor.snap")), 3
+        )
+        # store attached BEFORE the setup import, like the reference's
+        # mustOpenFragment (attaching after would let open() load the
+        # empty file over the populated mirror)
+        sq = SnapshotQueue(workers=1)
+        frag = Fragment(n_words=SHARD_WIDTH // 32)
+        store = FragmentFile(frag, os.path.join(d, "frag"), sq)
+        store.open()
+        frag.store = store
+        frag.import_bits(rows_all, cols_all)
+        sq.await_all()
+
+        repo_t = _best(store.snapshot, 3)
+        repo_bytes = os.path.getsize(os.path.join(d, "frag"))
+        assert repo_bytes > 1_000_000, repo_bytes
+        sq.stop()
+        store.close()
+    rb.close()
+    results["full_snapshot"] = {
+        "reference": "BenchmarkFragment_FullSnapshot "
+        "(fragment_internal_test.go:1964)",
+        "anchor_ms": round(anchor_t * 1e3, 1),
+        "repo_ms": round(repo_t * 1e3, 1),
+        "repo_vs_anchor": round(anchor_t / repo_t, 3),
+    }
+
+
+def bench_import_update(results: dict) -> None:
+    """BenchmarkImportRoaringUpdate Rows1000Cols50000: zipf-1000-row
+    base (snapshotted), then a 50k-position update import INCLUDING the
+    snapshot it triggers (the benchmark calls awaitSnapshot; 50k
+    changed bits >> MaxOpN=10000 forces a full rewrite)."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.storage.fragmentfile import FragmentFile, SnapshotQueue
+    from pilosa_tpu.ops import _refanchor
+
+    rng = np.random.default_rng(1)
+    base_rows = zipf_rows(rng, 1000, SHARD_WIDTH)
+    base_cols = np.arange(SHARD_WIDTH, dtype=np.uint64)
+    up_rows = zipf_rows(rng, 1000, 50000)
+    up_cols = rng.integers(0, SHARD_WIDTH, size=50000).astype(np.uint64)
+
+    with tempfile.TemporaryDirectory() as d:
+        def anchor_once():
+            rb = _refanchor.RefBitmap()
+            rb.addn_sorted(np.unique(base_rows * SHARD_WIDTH + base_cols))
+            t0 = time.perf_counter()
+            rb.addn_sorted(np.unique(up_rows * SHARD_WIDTH + up_cols))
+            for r in np.unique(up_rows):
+                rb.count_range(
+                    int(r) * SHARD_WIDTH, (int(r) + 1) * SHARD_WIDTH
+                )
+            rb.snapshot(os.path.join(d, "anchor.snap"))
+            dt = time.perf_counter() - t0
+            rb.close()
+            return dt
+
+        def repo_once():
+            sq = SnapshotQueue(workers=1)
+            frag = Fragment(n_words=SHARD_WIDTH // 32)
+            store = FragmentFile(frag, os.path.join(d, "frag"), sq)
+            store.open()
+            frag.store = store
+            frag.import_bits(base_rows.copy(), base_cols.copy())
+            store.snapshot()  # base state snapshotted, like the reference
+            t0 = time.perf_counter()
+            frag.import_bits(up_rows.copy(), up_cols.copy())
+            sq.await_all()
+            dt = time.perf_counter() - t0
+            sq.stop()
+            store.close()
+            for fn in os.listdir(d):
+                if fn.startswith("frag"):
+                    os.unlink(os.path.join(d, fn))
+            return dt
+
+        anchor_t = min(anchor_once() for _ in range(3))
+        repo_t = min(repo_once() for _ in range(3))
+    results["import_update"] = {
+        "reference": "BenchmarkImportRoaringUpdate Rows1000Cols50000 "
+        "(fragment_internal_test.go:2190)",
+        "anchor_ms": round(anchor_t * 1e3, 1),
+        "repo_ms": round(repo_t * 1e3, 1),
+        "repo_vs_anchor": round(anchor_t / repo_t, 3),
+    }
+
+
+MD_BEGIN = "<!-- ref-anchor:begin -->"
+MD_END = "<!-- ref-anchor:end -->"
+
+
+def update_baseline_md(results: dict, path: str) -> None:
+    lines = [
+        MD_BEGIN,
+        "",
+        "## Measured reference anchors (round 5)",
+        "",
+        "No Go toolchain exists in this image, so the reference's key",
+        "benchmarks are PORTED: the anchor side is a compiled C++ port of",
+        "the reference's roaring container algorithms (native/refanchor.cpp"
+        " —",
+        "conservative: sorted-merge AddN is faster than the original's",
+        "per-position btree seeks), the repo side is this framework's real",
+        "path for the same semantic work, same data, same host "
+        "(single-core).",
+        "Regenerate: `python tools/ref_anchor.py --baseline-md`.",
+        "",
+        "| benchmark (reference) | anchor | repo | repo/anchor |",
+        "|---|---|---|---|",
+    ]
+    ic = results["intersection_count"]
+    lines.append(
+        f"| IntersectionCount (lone sparse pair) | {ic['anchor_us']} us "
+        f"| {ic['repo_us']} us | {ic['repo_vs_anchor']}x |"
+    )
+    for k, v in results["import_standard"].items():
+        if k == "reference":
+            continue
+        lines.append(
+            f"| ImportStandard {k} | {v['anchor_mbits_s']} Mbit/s "
+            f"| {v['repo_mbits_s']} Mbit/s | {v['repo_vs_anchor']}x |"
+        )
+    fs = results["full_snapshot"]
+    lines.append(
+        f"| FullSnapshot | {fs['anchor_ms']} ms | {fs['repo_ms']} ms "
+        f"| {fs['repo_vs_anchor']}x |"
+    )
+    iu = results["import_update"]
+    lines.append(
+        f"| ImportRoaringUpdate 1000r/50kc | {iu['anchor_ms']} ms "
+        f"| {iu['repo_ms']} ms | {iu['repo_vs_anchor']}x |"
+    )
+    lines += [
+        "",
+        "repo/anchor > 1 means the repo is faster. The lone sparse",
+        "IntersectionCount is the dense layout's worst case by design —",
+        "see docs/parity.md; batched and repeat serving regimes are",
+        "covered by bench.py's serving_* and batched figures.",
+        "",
+        MD_END,
+    ]
+    block = "\n".join(lines)
+    with open(path) as f:
+        text = f.read()
+    if MD_BEGIN in text:
+        pre = text[: text.index(MD_BEGIN)]
+        post = text[text.index(MD_END) + len(MD_END) :]
+        text = pre + block + post
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline-md", action="store_true")
+    args = ap.parse_args()
+
+    # the anchors never touch the device; keep jax off the accelerator
+    # so import side-effects can't skew the host timings
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from pilosa_tpu.ops import _refanchor
+
+    if _refanchor.load() is None:
+        print(json.dumps({"error": "refanchor library unavailable"}))
+        return 1
+
+    results: dict = {}
+    bench_intersection_count(results)
+    bench_import_standard(results)
+    bench_full_snapshot(results)
+    bench_import_update(results)
+    print(json.dumps(results, indent=1))
+    if args.baseline_md:
+        update_baseline_md(
+            results,
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BASELINE.md"),
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
