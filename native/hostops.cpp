@@ -170,6 +170,82 @@ size_t ph_extract(const uint8_t* words, size_t n_words, uint64_t base,
     return k;
 }
 
+// One-pass bulk-import merge over SORTED compact keys (row_index *
+// width + col, duplicates allowed) — the whole middle of
+// Fragment.import_bits (reference fragment.go:2052 importPositions ->
+// roaring AddN/RemoveN + changed tracking) as a single native pass:
+// sets/clears mirror bits, and emits, in one walk, everything the
+// Python layer needs afterwards:
+//   wal_pos[c]        changed positions as original-row-id*width+col
+//                     (ascending row-major, the op-log record order)
+//   perrow[ri]        changed-bit count per row index (TopN maintained
+//                     counts + dirty-slot set)
+//   changed_words[w]  flat mirror word indices that changed, deduped
+//                     (word-granular device delta sync)
+// Returns the changed-bit count.  The caller owns bounds: keys must
+// lie in [0, n_rows*width) and slots/mirror must cover them.
+// ``id_keys``: keys are row_id*width+col (skips the caller-side
+// inverse/searchsorted pass entirely); the row index is recovered by a
+// binary search over the sorted ``row_ids`` once per ROW RUN — a few
+// thousand searches against a million-key pass.  0 means keys are
+// row_index*width+col.
+int64_t ph_import_merge(const int64_t* keys, size_t n, int64_t width,
+                        int64_t n_words, const int64_t* slots,
+                        const uint64_t* row_ids, size_t n_rows,
+                        int id_keys, uint8_t* mirror, int clear,
+                        uint64_t* wal_pos, int64_t* perrow,
+                        int64_t* changed_words,
+                        int64_t* n_changed_words) {
+    uint32_t* m32 = reinterpret_cast<uint32_t*>(mirror);
+    int64_t ri = -1;
+    int64_t row_lo = 0, row_hi = 0;  // current row's key range
+    uint32_t* row_base = nullptr;
+    uint64_t wal_base = 0;
+    int64_t nc = 0, nw = 0;
+    for (size_t i = 0; i < n; i++) {
+        int64_t k = keys[i];
+        if (k >= row_hi || k < row_lo) {
+            int64_t row_of_k = k / width;
+            if (id_keys) {
+                uint64_t rid = static_cast<uint64_t>(row_of_k);
+                size_t lo = 0, hi = n_rows;
+                while (lo < hi) {
+                    size_t mid = (lo + hi) / 2;
+                    if (row_ids[mid] < rid) lo = mid + 1;
+                    else hi = mid;
+                }
+                ri = static_cast<int64_t>(lo);
+            } else {
+                ri = row_of_k;
+            }
+            row_lo = row_of_k * width;
+            row_hi = row_lo + width;
+            row_base = m32 + slots[ri] * n_words;
+            wal_base = row_ids[ri] * static_cast<uint64_t>(width);
+        }
+        int64_t col = k - row_lo;
+        int64_t w = col >> 5;
+        uint32_t bit = 1u << (col & 31);
+        uint32_t& word = row_base[w];
+        if (clear) {
+            if (!(word & bit)) continue;
+            word &= ~bit;
+        } else {
+            if (word & bit) continue;
+            word |= bit;
+        }
+        wal_pos[nc] = wal_base + static_cast<uint64_t>(col);
+        perrow[ri]++;
+        nc++;
+        int64_t flat = slots[ri] * n_words + w;
+        if (nw == 0 || changed_words[nw - 1] != flat) {
+            changed_words[nw++] = flat;
+        }
+    }
+    *n_changed_words = nw;
+    return nc;
+}
+
 // Batched fused pair counts over many same-length row pairs — the
 // multi-shard latency-tier fan (one call per chunk; the caller spreads
 // chunks across Python threads only when cores allow).  Addresses

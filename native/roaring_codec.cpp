@@ -280,20 +280,72 @@ bool deserialize_any(const uint8_t* data, size_t len,
 
 // -- serializer -------------------------------------------------------------
 
+struct Header {
+  uint64_t key;
+  uint16_t type;
+  uint16_t card_minus_1;
+};
+
+// Encode one container from its SORTED low-16 values and run count;
+// smallest encoding wins, ties keep the earlier candidate in
+// array < run < bitmap order (mirrors the Python serializer's min()
+// over (size, type) tuples).
+void emit_container(uint64_t key, const std::vector<uint16_t>& vals,
+                    size_t run_count, std::vector<Header>* headers,
+                    std::vector<std::vector<uint8_t>>* datas) {
+  size_t n = vals.size();
+  size_t array_size = 2 * n;
+  size_t run_size = 2 + 4 * run_count;
+  size_t bitmap_size = 8192;
+  size_t inf = size_t(1) << 30;
+  uint16_t type = kTypeArray;
+  size_t best = n <= kArrayMaxSize ? array_size : inf;
+  size_t run_eff = run_count <= kRunMaxSize ? run_size : inf;
+  if (run_eff < best) {
+    best = run_eff;
+    type = kTypeRun;
+  }
+  if (bitmap_size < best) {
+    best = bitmap_size;
+    type = kTypeBitmap;
+  }
+
+  std::vector<uint8_t> data;
+  if (type == kTypeArray) {
+    data.resize(2 * n);
+    std::memcpy(data.data(), vals.data(), 2 * n);  // little-endian host
+  } else if (type == kTypeRun) {
+    push_le<uint16_t>(data, uint16_t(run_count));
+    uint16_t start = vals[0];
+    for (size_t k = 1; k <= n; k++) {
+      if (k == n || vals[k] != uint16_t(vals[k - 1] + 1)) {
+        push_le<uint16_t>(data, start);
+        push_le<uint16_t>(data, vals[k - 1]);
+        if (k < n) start = vals[k];
+      }
+    }
+  } else {
+    data.assign(8192, 0);
+    for (uint16_t v : vals) data[v >> 3] |= uint8_t(1) << (v & 7);
+  }
+  headers->push_back({key, type, uint16_t(n - 1)});
+  datas->push_back(std::move(data));
+}
+
+void assemble(const std::vector<Header>& headers,
+              const std::vector<std::vector<uint8_t>>& datas, uint8_t flags,
+              std::vector<uint8_t>* out);
+
 void serialize_positions(std::vector<uint64_t> positions, uint8_t flags,
                          std::vector<uint8_t>* out) {
   std::sort(positions.begin(), positions.end());
   positions.erase(std::unique(positions.begin(), positions.end()),
                   positions.end());
 
-  struct Header {
-    uint64_t key;
-    uint16_t type;
-    uint16_t card_minus_1;
-  };
   std::vector<Header> headers;
   std::vector<std::vector<uint8_t>> datas;
 
+  std::vector<uint16_t> vals;
   size_t i = 0;
   while (i < positions.size()) {
     uint64_t key = positions[i] >> 16;
@@ -304,53 +356,133 @@ void serialize_positions(std::vector<uint64_t> positions, uint8_t flags,
     size_t run_count = 1;
     for (size_t k = i + 1; k < j; k++)
       if (positions[k] != positions[k - 1] + 1) run_count++;
-    size_t array_size = 2 * n;
-    size_t run_size = 2 + 4 * run_count;
-    size_t bitmap_size = 8192;
-
-    // Smallest encoding wins; ties keep the earlier candidate in
-    // array < run < bitmap order (mirrors the Python serializer's
-    // min() over (size, type) tuples).
-    size_t inf = size_t(1) << 30;
-    uint16_t type = kTypeArray;
-    size_t best = n <= kArrayMaxSize ? array_size : inf;
-    size_t run_eff = run_count <= kRunMaxSize ? run_size : inf;
-    if (run_eff < best) {
-      best = run_eff;
-      type = kTypeRun;
-    }
-    if (bitmap_size < best) {
-      best = bitmap_size;
-      type = kTypeBitmap;
-    }
-
-    std::vector<uint8_t> data;
-    if (type == kTypeArray) {
-      data.reserve(2 * n);
-      for (size_t k = i; k < j; k++)
-        push_le<uint16_t>(data, uint16_t(positions[k] & 0xFFFF));
-    } else if (type == kTypeRun) {
-      push_le<uint16_t>(data, uint16_t(run_count));
-      uint16_t start = uint16_t(positions[i] & 0xFFFF);
-      for (size_t k = i + 1; k <= j; k++) {
-        if (k == j || positions[k] != positions[k - 1] + 1) {
-          push_le<uint16_t>(data, start);
-          push_le<uint16_t>(data, uint16_t(positions[k - 1] & 0xFFFF));
-          if (k < j) start = uint16_t(positions[k] & 0xFFFF);
-        }
-      }
-    } else {
-      data.assign(8192, 0);
-      for (size_t k = i; k < j; k++) {
-        uint16_t v = positions[k] & 0xFFFF;
-        data[v >> 3] |= uint8_t(1) << (v & 7);
-      }
-    }
-    headers.push_back({key, type, uint16_t(n - 1)});
-    datas.push_back(std::move(data));
+    vals.clear();
+    vals.reserve(n);
+    for (size_t k = i; k < j; k++)
+      vals.push_back(uint16_t(positions[k] & 0xFFFF));
+    emit_container(key, vals, run_count, &headers, &datas);
     i = j;
   }
+  assemble(headers, datas, flags, out);
+}
 
+// Serialize straight from dense row words — the snapshot hot path
+// (reference unprotectedWriteToFragment -> Bitmap.WriteTo walks its
+// containers the same way; here the containers are STREAMED off the
+// mirror words, so no 8-bytes-per-bit position array is ever
+// materialized).  ``slots[r]`` selects the word row for ascending
+// ``row_ids[r]``; byte output is identical to serialize_positions on
+// the extracted positions.
+// One 65536-bit container straight from its 2048 aligned words:
+// popcount + run starts are counted WORDWISE (a run start is a set bit
+// whose predecessor bit is clear: x & ~(x<<1 | carry)), the bitmap
+// payload is a straight memcpy, and the per-bit ctz walk only runs for
+// the small array/run winners.
+void emit_block(uint64_t key, const uint32_t* blk, std::vector<Header>* headers,
+                std::vector<std::vector<uint8_t>>* datas,
+                std::vector<uint16_t>* scratch) {
+  size_t n = 0, runs = 0;
+  uint64_t carry = 0;
+  for (size_t w = 0; w < 2048; w += 2) {
+    uint64_t x;  // two consecutive uint32 words; little-endian keeps
+    std::memcpy(&x, blk + w, 8);  // bit k == column (w*32 + k)
+    if (!x) {  // sparse rows skip at one compare per 8 bytes
+      carry = 0;
+      continue;
+    }
+    n += __builtin_popcountll(x);
+    runs += __builtin_popcountll(x & ~((x << 1) | carry));
+    carry = x >> 63;
+  }
+  if (n == 0) return;
+  size_t array_size = 2 * n;
+  size_t run_size = 2 + 4 * runs;
+  size_t inf = size_t(1) << 30;
+  size_t best_array = n <= kArrayMaxSize ? array_size : inf;
+  size_t best_run = runs <= kRunMaxSize ? run_size : inf;
+  if (size_t(8192) < best_array && size_t(8192) < best_run) {
+    // bitmap wins: payload is the words verbatim
+    std::vector<uint8_t> data(8192);
+    std::memcpy(data.data(), blk, 8192);
+    headers->push_back({key, kTypeBitmap, uint16_t(n - 1)});
+    datas->push_back(std::move(data));
+    return;
+  }
+  scratch->clear();
+  scratch->reserve(n);
+  for (size_t w = 0; w < 2048; w++) {
+    uint32_t x = blk[w];
+    while (x) {
+      scratch->push_back(uint16_t(w * 32 + __builtin_ctz(x)));
+      x &= x - 1;
+    }
+  }
+  emit_container(key, *scratch, runs, headers, datas);
+}
+
+void serialize_words(const uint64_t* row_ids, const int64_t* slots,
+                     size_t n_rows, const uint32_t* words, int64_t n_words,
+                     uint8_t flags, std::vector<uint8_t>* out) {
+  std::vector<Header> headers;
+  std::vector<std::vector<uint8_t>> datas;
+
+  if (n_words % 2048 == 0) {
+    // rows are whole containers (the default 2^20-bit shard width is
+    // 32768 words = 16 containers per row): stream container-aligned
+    // blocks, no cross-row state
+    std::vector<uint16_t> scratch;
+    for (size_t r = 0; r < n_rows; r++) {
+      uint64_t base_key = row_ids[r] * uint64_t(n_words) / 2048;
+      const uint32_t* row = words + slots[r] * n_words;
+      for (int64_t blk = 0; blk < n_words / 2048; blk++) {
+        emit_block(base_key + uint64_t(blk), row + blk * 2048, &headers,
+                   &datas, &scratch);
+      }
+    }
+    assemble(headers, datas, flags, out);
+    return;
+  }
+
+  uint64_t cur_key = ~uint64_t(0);
+  std::vector<uint16_t> vals;
+  size_t run_count = 0;
+  auto flush = [&]() {
+    if (!vals.empty()) {
+      emit_container(cur_key, vals, run_count, &headers, &datas);
+      vals.clear();
+    }
+  };
+  for (size_t r = 0; r < n_rows; r++) {
+    uint64_t base = row_ids[r] * uint64_t(n_words) * 32;
+    const uint32_t* row = words + slots[r] * n_words;
+    for (int64_t w = 0; w < n_words; w++) {
+      uint32_t word = row[w];
+      if (!word) continue;
+      uint64_t wbase = base + uint64_t(w) * 32;
+      while (word) {
+        int b = __builtin_ctz(word);
+        word &= word - 1;
+        uint64_t pos = wbase + b;
+        uint64_t key = pos >> 16;
+        uint16_t v = uint16_t(pos & 0xFFFF);
+        if (key != cur_key) {
+          flush();
+          cur_key = key;
+          run_count = 1;
+        } else if (v != uint16_t(vals.back() + 1)) {
+          run_count++;
+        }
+        vals.push_back(v);
+      }
+    }
+  }
+  flush();
+  assemble(headers, datas, flags, out);
+}
+
+void assemble(const std::vector<Header>& headers,
+              const std::vector<std::vector<uint8_t>>& datas, uint8_t flags,
+              std::vector<uint8_t>* out) {
   uint32_t count = headers.size();
   push_le<uint32_t>(*out, uint32_t(kMagic) | (uint32_t(flags) << 24));
   push_le<uint32_t>(*out, count);
@@ -380,6 +512,22 @@ int rt_serialize(const uint64_t* positions, size_t n, uint8_t flags,
   std::vector<uint8_t> buf;
   serialize_positions(std::vector<uint64_t>(positions, positions + n), flags,
                       &buf);
+  *out = static_cast<uint8_t*>(std::malloc(buf.size() ? buf.size() : 1));
+  if (!*out) return 2;
+  std::memcpy(*out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return 0;
+}
+
+// Serialize straight from dense row words (see serialize_words).
+// Returns 0 on success. *out is malloc'd; free with rt_free.
+int rt_serialize_words(const uint64_t* row_ids, const int64_t* slots,
+                       size_t n_rows, const uint8_t* words, int64_t n_words,
+                       uint8_t flags, uint8_t** out, size_t* out_len) {
+  std::vector<uint8_t> buf;
+  serialize_words(row_ids, slots, n_rows,
+                  reinterpret_cast<const uint32_t*>(words), n_words, flags,
+                  &buf);
   *out = static_cast<uint8_t*>(std::malloc(buf.size() ? buf.size() : 1));
   if (!*out) return 2;
   std::memcpy(*out, buf.data(), buf.size());

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.core import membudget
-from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops import _hostops, bitops
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WORDS
 
 # BSI row layout within a bsig_* view (reference fragment.go:90-96).
@@ -192,6 +192,31 @@ class Fragment:
             grown[: self.capacity] = self._host
             self._host = grown
             self._drop_device()  # full re-upload on next query
+
+    def _slots_batch(self, row_ids: np.ndarray) -> np.ndarray:
+        """Slots for every row id (ascending unique array), creating
+        missing ones with ONE capacity grow — a per-row _slot loop
+        re-copies the whole mirror at every doubling step during large
+        imports (caller holds the lock)."""
+        out = np.empty(row_ids.size, dtype=np.int64)
+        missing = []
+        for i, r in enumerate(row_ids):
+            s = self._slot_of.get(int(r))
+            if s is None:
+                missing.append(i)
+            else:
+                out[i] = s
+        if missing:
+            self._grow(len(self._rowids) + len(missing))
+            for i in missing:
+                r = int(row_ids[i])
+                s = len(self._rowids)
+                self._slot_of[r] = s
+                self._rowids.append(r)
+                out[i] = s
+            if self._counts is not None:
+                self._counts = None
+        return out
 
     def _slot(self, row: int, create: bool = False) -> int | None:
         s = self._slot_of.get(row)
@@ -550,20 +575,21 @@ class Fragment:
         if rows.size == 0:
             return 0
         with self._lock, self._batched_store():
-            counts0 = self._counts  # before _slot creation nulls it
+            counts0 = self._counts  # before slot creation nulls it
             # Group by row directly (never via row*width+col positions,
             # which would wrap uint64 for hashed row ids).
-            row_ids, inverse = np.unique(rows, return_inverse=True)
+            row_ids = np.unique(rows)
             if clear:
                 keep = np.array(
                     [int(r) in self._slot_of for r in row_ids], dtype=bool
                 )
                 if not keep.any():
                     return 0
-                sel = keep[inverse]
-                inverse = np.cumsum(keep)[inverse[sel]] - 1
-                cols = cols[sel]
-                row_ids = row_ids[keep]
+                if not keep.all():
+                    sel = keep[np.searchsorted(row_ids, rows)]
+                    rows = rows[sel]
+                    cols = cols[sel]
+                    row_ids = row_ids[keep]
                 for r in row_ids:  # BEFORE mutation: mirror/WAL atomicity
                     self._check_persistable(int(r))
                 slots = np.array(
@@ -572,17 +598,58 @@ class Fragment:
             else:
                 for r in row_ids:
                     self._check_persistable(int(r))
-                slots = np.array(
-                    [self._slot(int(r), create=True) for r in row_ids],
-                    dtype=np.int64,
-                )
-            # ONE sort (np.unique over flattened keys) drives everything:
-            # dedup, per-word grouping, changed-bit detection and WAL
-            # positions all fall out as vector passes over the sorted
-            # keys — no dense [rows, n_words] mask matrix and no
-            # unbuffered ufunc.at scalar loop (the previous hot spots).
+                slots = self._slots_batch(row_ids)
+            # ONE sort of compact keys drives everything: dedup,
+            # per-word grouping, changed-bit detection and WAL
+            # positions all fall out — no dense [rows, n_words] mask
+            # matrix and no unbuffered ufunc.at scalar loop.  The merge
+            # itself is a single native pass when the toolchain exists
+            # (hostops.cpp ph_import_merge: the roaring AddN/RemoveN
+            # role, reference fragment.go:2052), with the vectorized
+            # numpy pipeline as fallback.
             width = self.n_words * 32
-            key = inverse.astype(np.int64) * width + cols
+            native = None
+            if (
+                _hostops.load() is not None
+                and int(row_ids[-1]) <= (2**62) // width
+            ):
+                # id-keyed fast path: no inverse/searchsorted pass at
+                # all — the native walk binary-searches row_ids once
+                # per row run
+                key = rows.astype(np.int64) * width + cols
+                key.sort()
+                native = _hostops.import_merge(
+                    key, width, self.n_words, slots, row_ids,
+                    self._host, clear, id_keys=True,
+                )
+            if native is None:
+                inverse = np.searchsorted(row_ids, rows)
+                key = inverse.astype(np.int64) * width + cols
+                key.sort()
+                native = _hostops.import_merge(
+                    key, width, self.n_words, slots, row_ids,
+                    self._host, clear,
+                )
+            if native is not None:
+                n_changed, positions, per_row, changed_word_idx = native
+                if n_changed:
+                    for i in np.nonzero(per_row)[0]:
+                        self._dirty.add(int(slots[i]))
+                    if self._word_delta is not None:
+                        self._delta_note(changed_word_idx)
+                    if self.store is not None:
+                        if clear:
+                            self.store.log_remove_positions(positions)
+                        else:
+                            self.store.log_add_positions(positions)
+                    self._counts_delta(
+                        counts0, slots, -per_row if clear else per_row
+                    )
+                    self.version += 1
+                    self.op_n += int(np.count_nonzero(per_row))
+                    if self.on_op is not None:
+                        self.on_op(self)
+                return int(n_changed)
             ukey = np.unique(key)
             urow = ukey // width  # index into row_ids/slots
             ucol = ukey % width
@@ -1036,6 +1103,23 @@ class Fragment:
                 if self._host[s].any():
                     out[row] = self._host[s].copy()
             return out
+
+    def snapshot_rows(self) -> tuple[np.ndarray, np.ndarray]:
+        """(ascending row ids uint64, stacked words [n, n_words]) — the
+        snapshot source as ONE fancy-index copy under the lock
+        (to_host_rows + np.stack would copy the mirror twice).
+        All-zero rows are kept; they serialize to zero containers."""
+        with self._lock:
+            if not self._slot_of:
+                return (
+                    np.empty(0, dtype=np.uint64),
+                    np.empty((0, self.n_words), dtype=np.uint32),
+                )
+            rids = np.array(sorted(self._slot_of), dtype=np.uint64)
+            slots = np.array(
+                [self._slot_of[int(r)] for r in rids], dtype=np.int64
+            )
+            return rids, self._host[slots]
 
     def load_host_rows(self, rows: dict[int, np.ndarray]) -> None:
         with self._lock:

@@ -34,9 +34,18 @@ _U64P = ctypes.POINTER(ctypes.c_uint64)
 OP_CODES = {"intersect": 0, "union": 1, "difference": 2, "xor": 3}
 
 
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
 def _bind(lib: ctypes.CDLL) -> None:
     lib.ph_popcount.restype = ctypes.c_uint64
     lib.ph_popcount.argtypes = [_U8P, ctypes.c_size_t]
+    lib.ph_import_merge.restype = ctypes.c_int64
+    lib.ph_import_merge.argtypes = [
+        _I64P, ctypes.c_size_t, ctypes.c_int64, ctypes.c_int64,
+        _I64P, _U64P, ctypes.c_size_t, ctypes.c_int, _U8P, ctypes.c_int,
+        _U64P, _I64P, _I64P, _I64P,
+    ]
     lib.ph_pair_count.restype = ctypes.c_uint64
     lib.ph_pair_count.argtypes = [
         _U8P, _U8P, ctypes.c_size_t, ctypes.c_int,
@@ -139,6 +148,49 @@ def extract_positions(words: np.ndarray, base: int = 0) -> np.ndarray | None:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
     )
     return out[:k]
+
+
+def import_merge(
+    keys: np.ndarray,
+    width: int,
+    n_words: int,
+    slots: np.ndarray,
+    row_ids: np.ndarray,
+    mirror: np.ndarray,
+    clear: bool,
+    id_keys: bool = False,
+) -> tuple[int, np.ndarray, np.ndarray, np.ndarray] | None:
+    """One native pass over SORTED keys (``row_index*width + col``, or
+    ``row_id*width + col`` with ``id_keys=True``; duplicates allowed):
+    apply the bulk set/clear to ``mirror`` (uint32 [capacity, n_words],
+    mutated in place) and return
+    ``(n_changed, wal_positions, perrow_changed, changed_word_indices)``
+    — everything Fragment.import_bits needs after the merge.  None when
+    no native library is available (callers keep their numpy path).
+    The caller owns key bounds and holds the fragment lock."""
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
+    wal = np.empty(keys.size, dtype=np.uint64)
+    perrow = np.zeros(slots.size, dtype=np.int64)
+    cw = np.empty(keys.size, dtype=np.int64)
+    ncw = np.zeros(1, dtype=np.int64)
+    nc = int(
+        lib.ph_import_merge(
+            keys.ctypes.data_as(_I64P), keys.size, width, n_words,
+            slots.ctypes.data_as(_I64P),
+            row_ids.ctypes.data_as(_U64P), row_ids.size, int(id_keys),
+            _u8(mirror), int(clear),
+            wal.ctypes.data_as(_U64P),
+            perrow.ctypes.data_as(_I64P),
+            cw.ctypes.data_as(_I64P),
+            ncw.ctypes.data_as(_I64P),
+        )
+    )
+    return nc, wal[:nc], perrow, cw[: int(ncw[0])]
 
 
 def pair_op(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:

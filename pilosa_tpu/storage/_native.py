@@ -53,6 +53,17 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        lib.rt_serialize_words.restype = ctypes.c_int
+        lib.rt_serialize_words.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_uint8,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         lib.rt_deserialize.restype = ctypes.c_int
         lib.rt_deserialize.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
@@ -91,6 +102,44 @@ def serialize(positions: np.ndarray, flags: int = 0) -> bytes | None:
     rc = lib.rt_serialize(
         positions.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         positions.size,
+        flags,
+        ctypes.byref(out),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.rt_free(out)
+
+
+def serialize_words(
+    row_ids: np.ndarray,
+    slots: np.ndarray,
+    words: np.ndarray,
+    flags: int = 0,
+) -> bytes | None:
+    """Roaring-serialize straight from dense row words (uint32
+    [capacity, n_words] mirror; ``slots[r]`` is the word row of
+    ascending ``row_ids[r]``) without materializing a positions array —
+    byte-identical to ``serialize(positions)``.  None when
+    unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    if not words.flags["C_CONTIGUOUS"] or words.dtype != np.uint32:
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    rc = lib.rt_serialize_words(
+        row_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        row_ids.size,
+        words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        words.shape[-1],
         flags,
         ctypes.byref(out),
         ctypes.byref(out_len),

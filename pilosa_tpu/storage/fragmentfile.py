@@ -23,6 +23,10 @@ from pilosa_tpu.storage import roaring
 # reference fragment.go:84.
 MAX_OP_N = 10000
 
+# WAL fsync policy — see _append_many.  "snapshot" (default, reference
+# durability parity) | "batch" (fsync every WAL batch).
+WAL_FSYNC = os.environ.get("PILOSA_TPU_WAL_FSYNC", "snapshot")
+
 # Batch ops chunk size: bounds the pure-python fnv checksum cost per record.
 _BATCH_CHUNK = 65536
 
@@ -143,11 +147,18 @@ class FragmentFile:
         self._append_many([record], count)
 
     def _append_many(self, records: list[bytes], count: int) -> None:
-        """Append several records with ONE flush+fsync — a bulk batch is
-        durable as a unit (each record still carries its own checksum,
-        so a torn tail replays cleanly), and the reference's
-        WAL-amortized import pays one sync per bulk call too
-        (fragment.go:1995-2280)."""
+        """Append several records with ONE flush (each record carries
+        its own checksum, so a torn tail replays cleanly).
+
+        fsync policy (``PILOSA_TPU_WAL_FSYNC``): the default
+        ``snapshot`` syncs only snapshot files — exactly the
+        reference's durability (its op-log writes land in the OS page
+        cache with no Sync, roaring.go:1655 writeOp; only snapshot
+        rewrites fsync, fragment.go:2750), so a process crash loses
+        nothing and an OS/power crash can lose ops since the last
+        snapshot.  ``batch`` additionally fsyncs every WAL batch —
+        stronger than the reference, at ~35 ms per sync on this host's
+        disk (it was the bottleneck of sustained ingest)."""
         if not records:
             return
         with self._lock:
@@ -156,7 +167,8 @@ class FragmentFile:
             for record in records:
                 self._fh.write(record)
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            if WAL_FSYNC == "batch":
+                os.fsync(self._fh.fileno())
             self.op_n += count
             self.mut_seq += 1
         if self.op_n > MAX_OP_N:
@@ -295,7 +307,7 @@ class FragmentFile:
                         if self._closed:
                             return
                         self._write_snapshot_file(
-                            roaring.serialize(self._all_positions())
+                            self._encode_rows(*self.fragment.snapshot_rows())
                         )
                         return
                 with self._lock:
@@ -305,8 +317,8 @@ class FragmentFile:
                         # cleanup) must not resurrect the deleted file.
                         return
                     seq_at = self.mut_seq
-                items = sorted(self.fragment.to_host_rows().items())
-            data = roaring.serialize(self._positions_from_items(items))
+                rids, rwords = self.fragment.snapshot_rows()
+            data = self._encode_rows(rids, rwords)
             with self.fragment._lock, self._lock:
                 if self._closed:
                     return
@@ -328,21 +340,14 @@ class FragmentFile:
         self._fh = open(self.path, "ab")
         self.op_n = 0
 
-    def _positions_from_items(
-        self, items: list[tuple[int, np.ndarray]]
-    ) -> np.ndarray:
-        """Snapshot payload for sorted (row, mask) pairs — shared by the
-        optimistic and lock-held rewrite paths so they can't diverge."""
-        if not items:
-            return np.empty(0, dtype=np.uint64)
-        rows = np.array([r for r, _ in items], dtype=np.uint64)
-        masks = np.stack([w for _, w in items])
-        return self._positions_multi(rows, masks)
-
-    def _all_positions(self) -> np.ndarray:
-        return self._positions_from_items(
-            sorted(self.fragment.to_host_rows().items())
-        )
+    def _encode_rows(self, rids: np.ndarray, rwords: np.ndarray) -> bytes:
+        """Snapshot bytes for ascending row ids + stacked words: the
+        native words->roaring streaming encoder when available, else
+        the positions pipeline (byte-identical output)."""
+        data = roaring.serialize_rows(rids, rwords)
+        if data is not None:
+            return data
+        return roaring.serialize(self._positions_multi(rids, rwords))
 
     def close(self) -> None:
         with self._lock:

@@ -75,6 +75,22 @@ class RoaringError(Exception):
 # ---------------------------------------------------------------------------
 
 
+def serialize_rows(
+    row_ids: np.ndarray, words: np.ndarray, flags: int = 0
+) -> bytes | None:
+    """Ascending row ids + stacked words [n, n_words] -> roaring file
+    bytes, streamed straight off the dense words by the native codec
+    (byte-identical to ``serialize`` on the extracted positions, with
+    no 8-bytes-per-bit positions array); None when the native codec is
+    unavailable — callers fall back to the positions path."""
+    if not len(row_ids):
+        return _native.serialize(np.empty(0, dtype=np.uint64), flags)
+    slots = np.arange(len(row_ids), dtype=np.int64)
+    return _native.serialize_words(
+        np.asarray(row_ids, dtype=np.uint64), slots, words, flags
+    )
+
+
 def serialize(positions: np.ndarray, flags: int = 0) -> bytes:
     """Sorted uint64 bit positions -> Pilosa roaring file bytes.
 

@@ -49,7 +49,10 @@ class TestHolderStore:
         store2.close()
 
     def test_oplog_durable_without_sync(self, tmp_path):
-        # mutations must survive without close() (op-log fsync'd appends)
+        # mutations must survive a PROCESS crash without close(): WAL
+        # appends are flushed to the OS page cache (fsync policy
+        # PILOSA_TPU_WAL_FSYNC defaults to the reference's
+        # snapshot-only durability; "batch" restores per-batch fsync)
         h, store, ex = make(tmp_path)
         h.create_index("i").create_field("f")
         store.sync()  # schema needs one sync
@@ -344,20 +347,24 @@ class TestSnapshotConcurrentWrite:
         frag.set_bit(1, 10)
         frag.set_bit(2, 20)
 
-        real_serialize = fragmentfile.roaring.serialize
+        real_serialize = fragmentfile.roaring.serialize_rows
         fired = {"n": 0}
 
-        def racing_serialize(positions):
+        def racing_serialize(*a):
             # simulate a concurrent writer landing mid-encode, exactly
-            # once (the retried snapshot also calls serialize)
+            # once (the retried snapshot also calls the encoder)
             if fired["n"] == 0:
                 fired["n"] += 1
                 frag.set_bit(3, 30)
-            return real_serialize(positions)
+            return real_serialize(*a)
 
-        monkeypatch.setattr(fragmentfile.roaring, "serialize", racing_serialize)
+        monkeypatch.setattr(
+            fragmentfile.roaring, "serialize_rows", racing_serialize
+        )
         store.snapshot()
-        monkeypatch.setattr(fragmentfile.roaring, "serialize", real_serialize)
+        monkeypatch.setattr(
+            fragmentfile.roaring, "serialize_rows", real_serialize
+        )
         store.close()
 
         frag2 = Fragment(n_words=64)
@@ -380,22 +387,26 @@ class TestSnapshotConcurrentWrite:
         store.open()
         frag.set_bit(1, 10)
 
-        real_serialize = fragmentfile.roaring.serialize
+        real_serialize = fragmentfile.roaring.serialize_rows
         retries = FragmentFile._SNAPSHOT_RETRIES
         calls = {"n": 0}
 
-        def always_racing(positions):
+        def always_racing(*a):
             # a new op lands during every LOCK-FREE encode (the final,
-            # lock-held attempt is the (retries+1)-th serialize call and
+            # lock-held attempt is the (retries+1)-th encoder call and
             # must not mutate: the caller holds both locks there)
             calls["n"] += 1
             if calls["n"] <= retries:
                 frag.set_bit(10 + calls["n"], 5)
-            return real_serialize(positions)
+            return real_serialize(*a)
 
-        monkeypatch.setattr(fragmentfile.roaring, "serialize", always_racing)
+        monkeypatch.setattr(
+            fragmentfile.roaring, "serialize_rows", always_racing
+        )
         store.snapshot()  # must terminate
-        monkeypatch.setattr(fragmentfile.roaring, "serialize", real_serialize)
+        monkeypatch.setattr(
+            fragmentfile.roaring, "serialize_rows", real_serialize
+        )
         assert calls["n"] == retries + 1  # every optimistic attempt raced
         assert store.op_n == 0  # rewrite completed
         store.close()
