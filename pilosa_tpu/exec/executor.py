@@ -765,13 +765,10 @@ class Executor:
                     continue
                 # gram declined (too many distinct rows): scan kernels,
                 # one launch per op, padded to powers of two for program
-                # reuse.  [B, S] per-shard partials summed host-side in
-                # int64 so totals past 2^31 stay exact.  The scan
-                # kernels' partials are not host addressable on a
-                # process-spanning stack — those items stay unset and
-                # the ordinary per-call path serves them.
-                if kernels.stack_spans_processes(bits):
-                    continue
+                # reuse.  Local stacks return [B, S] per-shard partials
+                # (summed host-side in int64 so totals past 2^31 stay
+                # exact); process-spanning stacks return replicated
+                # int64[B] in-program psum totals (kernels.py r05).
                 by_op: dict[str, list[tuple[int, int, int]]] = {}
                 for i, op, sa, sb in launch:
                     by_op.setdefault(op, []).append((i, sa, sb))
@@ -786,7 +783,10 @@ class Executor:
                             bits, jnp.asarray(ras), jnp.asarray(rbs), op=op
                         )
                     ).astype(np.int64)
-                    counts = partials.sum(axis=1)
+                    counts = (
+                        partials if partials.ndim == 1
+                        else partials.sum(axis=1)
+                    )
                     for j, (i, _, _) in enumerate(olaunch):
                         results[i] = int(counts[j])
                         _count_stat()
@@ -2109,12 +2109,11 @@ class Executor:
 
             stack = self._field_stack(field, shards)
             if stack is not None:
-                # masked counts aren't supported on process-spanning
-                # stacks (nor plain counts past their int32 bound);
-                # the per-fragment loop below answers instead
-                if kernels.stack_spans_processes(
-                    stack[1]
-                ) or not kernels.row_counts_supported(stack[1]):
+                # masked counts run in-program (psum) on
+                # process-spanning stacks too; the only decline left is
+                # totals past even a single-shard psum slice's int32
+                # bound — the per-fragment loop below answers then
+                if not kernels.row_counts_supported(stack[1]):
                     stack = None
             if stack is not None:
                 slot_of, bits = stack
@@ -2449,11 +2448,11 @@ class Executor:
             if counts2d is not None:
                 counts = counts2d.reshape(-1)
             else:
-                # the batched scan kernels below can't run on a
-                # process-spanning stack (non-addressable partials);
-                # decline to the recursive per-fragment engine instead
-                if kernels.stack_spans_processes(bits1):
-                    return None
+                # wide pair batches (> GRAM_MAX_ROWS distinct rows):
+                # local stacks return [B, S] partials; process-spanning
+                # stacks return replicated int64[B] in-program psum
+                # totals (kernels.py r05 — the fast lane no longer
+                # declines across hosts)
                 combos_s = [
                     (slot1[r1], slot2[r2])
                     for r1 in present1
@@ -2472,7 +2471,11 @@ class Executor:
                     partials = kernels.pair_count_two_batched(
                         bits1, bits2, jnp.asarray(ras), jnp.asarray(rbs)
                     )
-                counts = np.asarray(partials).astype(np.int64).sum(axis=1)
+                partials = np.asarray(partials).astype(np.int64)
+                counts = (
+                    partials if partials.ndim == 1
+                    else partials.sum(axis=1)
+                )
         out = []
         for j, (r1, r2) in enumerate(
             (r1, r2) for r1 in present1 for r2 in present2

@@ -286,20 +286,28 @@ def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
 
 def pair_count_batched(
     bits: jax.Array, ras: jax.Array, rbs: jax.Array, *, op: str = "intersect"
-) -> jax.Array:
+):
+    """Pair counts for a query batch.  Local stacks return device
+    ``int32[B, S]`` per-shard partials (callers sum host-side); on a
+    PROCESS-SPANNING mesh those partials are not host addressable, so
+    the reduce happens in-program — chunked psum with (hi, lo)
+    carry-save past int32 — and the result is replicated
+    ``np.int64[B]`` totals (already summed over shards)."""
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
         if mesh_spans_processes(mesh):
-            # the [B, S] per-shard partials this kernel returns are not
-            # host addressable on a spanning mesh; pair counts there are
-            # supported only through pair_gram's psum reduce, which is
-            # itself bounded at GRAM_MAX_ROWS distinct rows per batch
-            raise NotImplementedError(
-                "pair_count_batched on a process-spanning mesh;"
-                " process-spanning stacks answer pair counts via"
-                f" pair_gram only (<= {GRAM_MAX_ROWS} distinct rows)"
+            _, _, W = bits.shape
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                raise ValueError(
+                    "pair totals exceed int32 even per single psum"
+                    " slice; shrink the shard width or the per-host mesh"
+                )
+            hi, lo = _psum_chunked_fn(mesh, axis, "pair:" + op, chunk)(
+                bits, ras, rbs
             )
+            return _hi_lo_total(hi, lo)
         return _pair_count_sharded_fn(mesh, axis, op, False)(bits, ras, rbs)
     return pair_count_batched_xla(bits, ras, rbs, op=op)
 
@@ -617,9 +625,11 @@ def row_counts_supported(bits) -> bool:
 
 def stack_spans_processes(x) -> bool:
     """Whether ``x`` is a shards-sharded stack whose mesh includes other
-    processes' devices.  The decline guard for every batched path whose
-    kernels return per-shard partials (not host addressable there):
-    callers fall through to per-fragment serving instead."""
+    processes' devices.  The decline guard for the remaining batched
+    paths whose kernels return per-shard partials (not host addressable
+    there) — the compiled-AST programs and the k-level GroupBy combo
+    engine; pair/masked/row counts and the grams now reduce in-program
+    (psum) on spanning meshes instead of declining."""
     m = shards_axis_of(x)
     return m is not None and mesh_spans_processes(m[0])
 
@@ -745,6 +755,41 @@ def _psum_chunked_fn(mesh, axis, kind, chunk):
             P(axis, None, None), P(axis, None, None), P(None), P(None)
         )
         out = P(None, None)
+    elif kind.startswith("pair2:"):
+        op = kind.split(":", 1)[1]
+        local = lambda a, b, ra, rb: _carry_psum_chunks(
+            lambda x, y: jnp.sum(
+                pair_count_two_batched_xla(x, y, ra, rb, op=op), axis=1
+            ),
+            (a, b),
+            axis,
+            chunk,
+        )
+        in_specs = (
+            P(axis, None, None), P(axis, None, None), P(None), P(None)
+        )
+        out = P(None)
+    elif kind.startswith("pair:"):
+        op = kind.split(":", 1)[1]
+        local = lambda b, ra, rb: _carry_psum_chunks(
+            lambda x: jnp.sum(
+                pair_count_batched_xla(x, ra, rb, op=op), axis=1
+            ),
+            (b,),
+            axis,
+            chunk,
+        )
+        in_specs = (P(axis, None, None), P(None), P(None))
+        out = P(None)
+    elif kind == "masked_rows":
+        local = lambda b, f: _carry_psum_chunks(
+            lambda x, ff: jnp.sum(masked_row_counts_xla(x, ff), axis=0),
+            (b, f),
+            axis,
+            chunk,
+        )
+        in_specs = (P(axis, None, None), P(axis, None))
+        out = P(None)
     else:  # rows
         local = lambda b: _carry_psum_chunks(
             row_counts_xla, (b,), axis, chunk
@@ -781,9 +826,9 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
     one-launch answer to a whole batch of pair-count queries
     (reference executor.go:653-680 + roaring.go:568, re-shaped for the
     MXU).  None when ``row_idx`` is too wide for the gram path
-    (> GRAM_MAX_ROWS); callers fall back to the scan kernels — except on
-    a process-spanning mesh, where the scan kernels raise and callers
-    must decline to per-fragment paths instead.
+    (> GRAM_MAX_ROWS); callers fall back to the scan kernels, which
+    serve process-spanning meshes too via in-program psum (replicated
+    int64 totals instead of per-shard partials — kernels.py r05).
 
     Works on single-device and shards-axis NamedSharding'd stacks; on a
     single-host mesh each device grams its local shard block and the
@@ -1124,18 +1169,26 @@ def pair_count_two_batched_xla(
 def pair_count_two_batched(
     bits_a: jax.Array, bits_b: jax.Array, ras: jax.Array, rbs: jax.Array,
     *, op: str = "intersect",
-) -> jax.Array:
+):
+    """Cross-tensor pair counts; same return contract as
+    ``pair_count_batched``: device ``int32[B, S]`` partials on local
+    stacks, replicated ``np.int64[B]`` in-program psum totals on a
+    process-spanning mesh."""
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:
         mesh, axis = m
         if mesh_spans_processes(mesh):
-            # same non-addressable-partials constraint as
-            # pair_count_batched; cross_pair_gram is the spanning path
-            raise NotImplementedError(
-                "pair_count_two_batched on a process-spanning mesh;"
-                " process-spanning stacks answer cross-field counts via"
-                f" cross_pair_gram only (<= {GRAM_MAX_ROWS} rows/side)"
+            _, _, W = bits_a.shape
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                raise ValueError(
+                    "pair totals exceed int32 even per single psum"
+                    " slice; shrink the shard width or the per-host mesh"
+                )
+            hi, lo = _psum_chunked_fn(mesh, axis, "pair2:" + op, chunk)(
+                bits_a, bits_b, ras, rbs
             )
+            return _hi_lo_total(hi, lo)
         return _pair_count_sharded_fn(mesh, axis, op, True)(
             bits_a, bits_b, ras, rbs
         )
@@ -1409,11 +1462,23 @@ def masked_row_counts(bits: jax.Array, filt: jax.Array):
     if m is not None:
         mesh, axis = m
         if mesh_spans_processes(mesh):
-            raise NotImplementedError(
-                "masked row counts (filtered TopN) are served from"
-                " per-host meshes; process-spanning stacks support"
-                " pair_gram/cross_pair_gram/row_counts"
+            # in-program psum (chunked hi/lo carry-save past int32):
+            # filtered TopN stays on the fast lane across hosts
+            _, _, W = bits.shape
+            chunk = _psum_chunk_size(mesh, W)
+            if chunk < 1:
+                raise ValueError(
+                    "masked row totals exceed int32 even per single"
+                    " psum slice; shrink the shard width or the"
+                    " per-host mesh"
+                )
+            fspec = NamedSharding(mesh, P(axis, None))
+            if getattr(filt, "sharding", None) != fspec:
+                filt = jax.device_put(np.asarray(filt), fspec)
+            hi, lo = _psum_chunked_fn(mesh, axis, "masked_rows", chunk)(
+                bits, filt
             )
+            return _hi_lo_total(hi, lo)
         fspec = NamedSharding(mesh, P(axis, None))
         if getattr(filt, "sharding", None) != fspec:
             filt = jax.device_put(np.asarray(filt), fspec)

@@ -55,9 +55,11 @@ def pair_op_count(bits, ra: jax.Array, rb: jax.Array, *, op: str) -> jax.Array:
     )
 
 
-def pair_counts_batched(bits, ras, rbs, *, op: str = "intersect") -> jax.Array:
-    """Batch of Count(op(Row, Row)) per-shard partials -> int32[B, S], one
-    launch (sum in int64 host-side; cross-shard totals may pass 2^31).
+def pair_counts_batched(bits, ras, rbs, *, op: str = "intersect"):
+    """Batch of Count(op(Row, Row)) in one launch: ``int32[B, S]``
+    per-shard partials on a local mesh (sum in int64 host-side;
+    cross-shard totals may pass 2^31), or replicated ``np.int64[B]``
+    in-program psum totals on a process-spanning mesh (kernels.py r05).
 
     Dispatches to the Pallas streaming kernel (ops/kernels.py) with an XLA
     scan fallback — the serving-mode replacement for the reference's
@@ -177,8 +179,12 @@ class ShardedField:
         """Answer a batch of Count(op(Row(a), Row(b))) in one device launch."""
         ras = jnp.asarray([self.slot(a) for a, _ in pairs], jnp.int32)
         rbs = jnp.asarray([self.slot(b) for _, b in pairs], jnp.int32)
-        out = pair_counts_batched(self.bits, ras, rbs, op=op)
-        return [int(c) for c in np.asarray(out).astype(np.int64).sum(axis=1)]
+        out = np.asarray(
+            pair_counts_batched(self.bits, ras, rbs, op=op)
+        ).astype(np.int64)
+        if out.ndim > 1:  # local mesh: [B, S] partials
+            out = out.sum(axis=1)
+        return [int(c) for c in out]
 
     def topn(self, n: int) -> list[tuple[int, int]]:
         n = min(n, len(self.row_ids)) or 1

@@ -150,6 +150,67 @@ assert rc.tolist() == want_rc, (rc.tolist(), want_rc)
 xg = kernels.cross_pair_gram(gbits, gbits, sub, [1, 3])
 assert np.array_equal(xg, want_gram[np.ix_(sub, [1, 3])])
 
+# ---- r05: the former spanning-mesh declines, now in-program psum ------
+import jax.numpy as jnp
+
+# batched pair counts: replicated int64[B] totals (no [B, S] partials)
+ras = np.array([0, 2, 1, 3], np.int32)
+rbs = np.array([1, 3, 4, 0], np.int32)
+pc = kernels.pair_count_batched(gbits, jnp.asarray(ras), jnp.asarray(rbs))
+assert pc.ndim == 1 and pc.dtype == np.int64, (pc.shape, pc.dtype)
+assert pc.tolist() == [int(want_gram[a, b]) for a, b in zip(ras, rbs)]
+
+# union op exercises the op-parameterized psum kind
+pu = kernels.pair_count_batched(
+    gbits, jnp.asarray(ras), jnp.asarray(rbs), op="union"
+)
+want_u = [
+    want_rc[a] + want_rc[b] - int(want_gram[a, b]) for a, b in zip(ras, rbs)
+]
+assert pu.tolist() == want_u, (pu.tolist(), want_u)
+
+# a batch WIDER than the gram lane's row bound (the shape that used to
+# raise NotImplementedError) stays on the fast lane across processes.
+# GRAM_MAX_ROWS is lowered in-process so the >bound case compiles in
+# seconds on the 1-core CI host (a 4096+-step scan program would not);
+# the kernel is bound-oblivious, only the batch width matters.
+old_gmr = kernels.GRAM_MAX_ROWS
+kernels.GRAM_MAX_ROWS = 16
+try:
+    Bw = kernels.GRAM_MAX_ROWS + 24
+    wa_ = np.arange(Bw, dtype=np.int32) % R
+    wb_ = (np.arange(Bw, dtype=np.int32) * 3 + 1) % R
+    pw = kernels.pair_count_batched(
+        gbits, jnp.asarray(wa_), jnp.asarray(wb_)
+    )
+finally:
+    kernels.GRAM_MAX_ROWS = old_gmr
+assert pw.shape == (Bw,)
+assert pw.tolist() == [int(want_gram[a, b]) for a, b in zip(wa_, wb_)]
+
+# cross-tensor variant (GroupBy's wide lane)
+p2 = kernels.pair_count_two_batched(
+    gbits, gbits, jnp.asarray(ras), jnp.asarray(rbs)
+)
+assert p2.ndim == 1
+assert p2.tolist() == [int(want_gram[a, b]) for a, b in zip(ras, rbs)]
+
+# filtered TopN: masked row counts psum + host top-k on the replicated
+# result — the executor's fast lane for TopN(f, filter=...) across hosts.
+# gbits' global shard axis is PROCESS-ordered (proc0's shards then
+# proc1's: [0, 2, 1, 3]); the filter must ride the same permutation.
+filt = np.zeros((N_SHARDS, W), np.uint32)
+for c in sorted(byrow.get(1, set())):
+    s, off = divmod(int(c), width)
+    filt[s, off // 32] |= np.uint32(1) << np.uint32(off % 32)
+shard_perm = [s for p in (0, 1) for s in range(N_SHARDS) if s % 2 == p]
+mc = kernels.masked_row_counts(gbits, filt[shard_perm])
+want_m = [len(byrow.get(r, set()) & byrow.get(1, set())) for r in range(R)]
+assert mc.tolist() == want_m, (mc.tolist(), want_m)
+top = sorted(range(R), key=lambda r: (-mc[r], r))[:3]
+want_top = sorted(range(R), key=lambda r: (-want_m[r], r))[:3]
+assert top == want_top
+
 # chunked carry-save path: a larger synthetic stack whose totals are
 # declared int32-UNSAFE by shrinking the accumulator limit, forcing
 # per-chunk psums combined as uint32 (hi, lo) pairs
@@ -177,6 +238,15 @@ try:
     x2 = kernels.cross_pair_gram(  # chunked cross kind
         gbits2, gbits2, [0, 2], [1]
     )
+    pc_c = kernels.pair_count_batched(  # chunked pair kind (r05)
+        gbits2, jnp.asarray([0, 1], np.int32), jnp.asarray([2, 0], np.int32)
+    )
+    p2_c = kernels.pair_count_two_batched(  # chunked pair2 kind (r05)
+        gbits2, gbits2,
+        jnp.asarray([0, 1], np.int32), jnp.asarray([2, 0], np.int32),
+    )
+    filt2 = np.full((S2, W2), 0xFFFFFFFF, np.uint32)
+    mc_c = kernels.masked_row_counts(gbits2, filt2)  # chunked masked kind
 finally:
     kernels._GRAM_ACC_LIMIT = old_limit
 # ground truth from the full array (order along the shard axis differs
@@ -192,6 +262,9 @@ assert np.array_equal(g2, want_g2), (g2.tolist(), want_g2.tolist())
 assert rc2.tolist() == [int(a.sum()) for a in rows2]
 assert np.array_equal(g2_sub, want_g2[np.ix_([0, 2], [0, 2])])
 assert np.array_equal(x2, want_g2[np.ix_([0, 2], [1])])
+assert pc_c.tolist() == [int(want_g2[0, 2]), int(want_g2[1, 0])]
+assert p2_c.tolist() == [int(want_g2[0, 2]), int(want_g2[1, 0])]
+assert mc_c.tolist() == [int(a.sum()) for a in rows2]  # full-filter = rc
 print(f"proc{pid} OK {total.tolist()} psum-gram OK", flush=True)
 """
 
