@@ -1,0 +1,110 @@
+"""In-process CPU sampling profiler and memory snapshot.
+
+The reference mounts net/http/pprof on its router (reference
+http/handler.go:280) and enables block/mutex profile rates
+(server.go:184-185); the analogues here are:
+
+* ``sample(seconds)`` — a statistical wall-clock sampler over
+  ``sys._current_frames()``: every tick it records the collapsed stack
+  of EVERY live thread (cProfile would only see the calling thread,
+  which is never the one serving queries).  Output is
+  flamegraph-collapsed format ("a;b;c count" lines), the same shape
+  ``go tool pprof``'s raw dumps collapse to.
+* ``memory_snapshot(holder)`` — RSS + per-component accounting: host
+  mirror bytes by index, device (HBM) budget state, GC and thread
+  counts — the heap-profile role, shaped to this runtime's actual
+  memory owners (numpy mirrors and HBM stacks, which a Python heap
+  profiler cannot see).
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+from collections import Counter
+
+
+def _collapse(frame) -> str:
+    parts: list[str] = []
+    while frame is not None:
+        code = frame.f_code
+        parts.append(f"{os.path.basename(code.co_filename)}:{code.co_name}")
+        frame = frame.f_back
+    return ";".join(reversed(parts))
+
+
+def sample(
+    seconds: float, interval: float = 0.005, max_seconds: float = 30.0
+) -> dict:
+    """Sample all threads' stacks for ``seconds`` (capped); returns
+    {"samples": N, "seconds": s, "interval_s": i,
+     "stacks": {collapsed_stack: count}, "threads": {name: count}}."""
+    seconds = max(0.05, min(float(seconds), max_seconds))
+    me = threading.get_ident()
+    names = {}
+    stacks: Counter[str] = Counter()
+    per_thread: Counter[str] = Counter()
+    n = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for t in threading.enumerate():
+            names[t.ident] = t.name
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue  # the sampler itself is noise
+            stacks[_collapse(frame)] += 1
+            per_thread[names.get(ident, str(ident))] += 1
+        n += 1
+        time.sleep(interval)
+    return {
+        "samples": n,
+        "seconds": seconds,
+        "interval_s": interval,
+        "stacks": dict(stacks.most_common()),
+        "threads": dict(per_thread.most_common()),
+    }
+
+
+def memory_snapshot(holder=None) -> dict:
+    """Process + framework memory accounting (the heap-profile role)."""
+    from pilosa_tpu.core import membudget
+    from pilosa_tpu.obs.sysinfo import SystemInfo
+
+    out: dict = {
+        "rss_bytes": SystemInfo().process_rss(),
+        "gc_counts": gc.get_count(),
+        "gc_collections": [s.get("collections") for s in gc.get_stats()],
+        "threads": threading.active_count(),
+    }
+    b = membudget.default_budget()
+    out["hbm_budget"] = {
+        "cap_bytes": b.cap,
+        "used_bytes": b.used(),
+        "entries": b.entry_count(),
+        "evictions": b.evictions,
+        "admissions": b.admissions,
+    }
+    if holder is not None:
+        per_index = {}
+        total = 0
+        frags = 0
+        for idx in list(holder.indexes.values()):
+            ibytes = 0
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    for frag in list(view.fragments.values()):
+                        host = getattr(frag, "_host", None)
+                        if host is not None:
+                            ibytes += host.nbytes
+                        frags += 1
+            per_index[idx.name] = ibytes
+            total += ibytes
+        out["host_mirrors"] = {
+            "total_bytes": total,
+            "fragments": frags,
+            "by_index": per_index,
+        }
+    return out

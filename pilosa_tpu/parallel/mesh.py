@@ -1,15 +1,23 @@
 """Device mesh construction.
 
-Two mesh axes cover this workload's parallelism inventory (SURVEY §2.3):
+One mesh axis covers this workload's parallelism (SURVEY §2.3):
 
 * ``shards`` — the data-parallel axis: columns striped into 2^20-wide
   shards, each device slice owning a contiguous set of shards (the
   analogue of the reference's shard→node jump-hash placement,
   cluster.go:858-934, made static because TPU meshes are static).
-* ``rows`` — the tensor-parallel-style axis: a fragment's row dimension
-  split across devices, so row-count scans (TopN/GroupBy) and BSI
-  plane walks parallelize within one shard.
-"""
+
+A second ``rows`` (tensor-parallel-style) axis existed through round 4
+but was DELIBERATELY collapsed (r05): every serving kernel's work is
+embarrassingly parallel along shards, so whenever the index has at
+least as many shards as the mesh has devices — the regime this design
+targets — an all-``shards`` split gives the identical per-device FLOP
+count with ZERO cross-device gathers, while a rows split forces a
+row-block all-gather into every pair/gram kernel.  Splitting rows only
+pays when shards < devices (a tiny index on a large pod), which the
+stacked layout handles anyway by padding the shard axis.  The axis name
+is kept in ``default_mesh`` signatures (size 1) so ShardedField's
+specs stay stable."""
 
 from __future__ import annotations
 
@@ -20,10 +28,8 @@ from jax.sharding import Mesh
 
 
 def mesh_shape_for(n_devices: int) -> tuple[int, int]:
-    """(shards, rows) axis sizes: prefer sharding columns; give the row
-    axis a factor of 2 when the device count allows."""
-    if n_devices % 2 == 0 and n_devices > 2:
-        return n_devices // 2, 2
+    """(shards, rows) axis sizes — all devices on the ``shards`` axis
+    (see the module docstring for why the rows factor was dropped)."""
     return n_devices, 1
 
 

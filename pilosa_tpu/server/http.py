@@ -48,6 +48,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
+    ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
+    ("GET", re.compile(r"^/debug/memory$"), "debug_memory"),
     ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),
     ("GET", re.compile(r"^/export$"), "export"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
@@ -238,6 +240,36 @@ class Handler(BaseHTTPRequestHandler):
                 }
             )
         self._send_json(200, {"threads": out, "count": len(out)})
+
+    def r_debug_profile(self):
+        """CPU sampling profile of every thread for ?seconds=N (cap 30);
+        flamegraph-collapsed stacks — the net/http/pprof profile-
+        endpoint role (reference http/handler.go:280).  The request
+        thread does the sampling; the threaded server keeps serving."""
+        from pilosa_tpu.obs import profile
+
+        try:
+            seconds = float(self.query_params.get("seconds", ["2"])[0])
+            interval = (
+                float(self.query_params.get("interval_ms", ["5"])[0]) / 1e3
+            )
+            if not (seconds == seconds and interval == interval):  # NaN
+                raise ValueError
+        except ValueError:
+            self._send_json(400, {"error": "bad seconds/interval_ms"})
+            return
+        # clamp BOTH ways: a huge/inf interval would park this server
+        # thread in time.sleep far past the seconds cap
+        interval = min(max(0.001, interval), 1.0)
+        self._send_json(200, profile.sample(seconds, interval))
+
+    def r_debug_memory(self):
+        """Heap/memory snapshot: RSS, host mirror bytes by index, HBM
+        budget accounting, GC state — the pprof heap-profile role
+        shaped to this runtime's actual memory owners."""
+        from pilosa_tpu.obs import profile
+
+        self._send_json(200, profile.memory_snapshot(self.api.holder))
 
     def r_diagnostics(self):
         """Diagnostics snapshot (reference diagnostics.go payload; local

@@ -11,6 +11,7 @@ cluster-disabled mode, server.go OptServerClusterDisabled).
 
 from __future__ import annotations
 
+import logging
 import uuid
 
 from pilosa_tpu.cluster import broadcast as bc
@@ -21,6 +22,8 @@ from pilosa_tpu.cluster.topology import Node
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.http import Server
+
+logger = logging.getLogger("pilosa_tpu.node")
 from pilosa_tpu.shardwidth import SHARD_WORDS
 from pilosa_tpu.storage.disk import HolderStore
 
@@ -228,10 +231,42 @@ class NodeServer:
 
     def join_static(self, members: list[tuple[str, str]], coordinator_id: str) -> None:
         """Fix cluster membership (reference cluster.go:2000 setStatic).
-        ``members`` is [(node_id, uri), ...] including this node."""
+        ``members`` is [(node_id, uri), ...] including this node.
+
+        Joining also performs the state HANDSHAKE: the coordinator's
+        NodeStatus — schema plus available-shard bitmaps — is pulled and
+        applied immediately, so a (re)started node answers
+        schema-dependent queries correctly BEFORE the first anti-entropy
+        pass (the reference exchanges full NodeStatus on every
+        memberlist push/pull sync, gossip.go:321-357).  Best-effort: at
+        initial cluster formation the coordinator may not be up yet, and
+        anti-entropy remains the healer of record."""
         self.cluster.coordinator_id = coordinator_id
         self.cluster.disabled = False
         self.cluster.set_static([Node(id=i, uri=u) for i, u in members])
+        if coordinator_id == self.cluster.node_id:
+            return
+        coord = next(
+            (n for n in self.cluster.nodes if n.id == coordinator_id), None
+        )
+        if coord is None or not coord.uri:
+            return
+        try:
+            status = self.client.status(coord.uri)
+        except Exception as e:
+            logger.warning(
+                "join handshake with coordinator %s failed (anti-entropy"
+                " will converge): %s", coordinator_id, e,
+            )
+            return
+        schema = status.get("schema")
+        if schema:
+            try:
+                self.holder.apply_schema(schema)
+            except Exception as e:
+                logger.warning("join handshake schema apply failed: %s", e)
+        if status.get("availableShards"):
+            self.api.merge_available_shards(status["availableShards"])
 
     def start_membership(
         self, probe_interval: float = 1.0, confirm_retries: int = 10,

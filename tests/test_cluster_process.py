@@ -45,7 +45,11 @@ srv.join_static(members, "node0")
 srv.start_membership(
     probe_interval=0.3, confirm_retries=2, confirm_interval=0.1
 )
-AntiEntropyLoop(srv.syncer(), 2.0).start()
+# interval overridable so the join-handshake test can park the loop far
+# in the future and prove convergence WITHOUT it
+AntiEntropyLoop(
+    srv.syncer(), float(os.environ.get("AE_INTERVAL", "2.0"))
+).start()
 print("READY", flush=True)
 threading.Event().wait()
 """
@@ -222,5 +226,72 @@ def test_kill_and_reconverge(tmp_path):
                 15,
                 f"node{pid} sees post-recovery write",
             )
+    finally:
+        procs.stop_all()
+
+
+def test_rejoin_handshake_serves_schema_before_anti_entropy(tmp_path):
+    """A restarted node pulls the coordinator's NodeStatus (schema +
+    available shards) in join_static itself, so a field created WHILE IT
+    WAS DOWN is queryable immediately — the anti-entropy loop is parked
+    600 s out and cannot be the healer here (reference gossip.go:321-357
+    join-time push/pull state exchange)."""
+    ports = _free_ports(2)
+    procs = _Procs(tmp_path, ports)
+    procs.env["AE_INTERVAL"] = "600"
+    try:
+        for pid in range(2):
+            procs.launch(pid)
+        for pid in range(2):
+            _wait(
+                lambda p=pid: _http(ports[p], "GET", "/status")["state"]
+                == "NORMAL",
+                30,
+                f"node{pid} NORMAL",
+            )
+        _http(ports[0], "POST", "/index/ci", {})
+        _http(ports[0], "POST", "/index/ci/field/cf", {})
+        _query(ports[0], "ci", "Set(5, cf=1)")
+
+        procs.kill(1)
+        _wait(
+            lambda: _http(ports[0], "GET", "/status")["state"] == "DEGRADED",
+            30,
+            "coordinator to see DEGRADED",
+        )
+        # schema mutations while node1 is down: a whole new field, and a
+        # second index — both must reach the rejoiner via the handshake
+        _http(ports[0], "POST", "/index/ci/field/nf", {})
+        _http(ports[0], "POST", "/index/ci2", {})
+        _http(ports[0], "POST", "/index/ci2/field/g", {})
+
+        t0 = time.time()
+        # _Procs.launch returns on the first /version poll, which can
+        # precede join_static's handshake by a few ms: wait for a NEW
+        # READY line (the log is append-mode across launches; READY
+        # prints AFTER join_static) so the query below proves the
+        # HANDSHAKE healed the schema, not luck — anti-entropy stays
+        # parked either way
+        log_path = tmp_path / "node1.log"
+        ready_before = log_path.read_bytes().count(b"READY")
+        procs.launch(1)
+        _wait(
+            lambda: log_path.read_bytes().count(b"READY") > ready_before,
+            30,
+            "rejoined worker past join_static",
+        )
+        # the rejoined node answers a query on the down-time field
+        # CORRECTLY (0, not field-not-found) straight away
+        got = _query(ports[1], "ci", "Count(Row(nf=7))")["results"][0]
+        assert got == 0, got
+        schema = _http(ports[1], "GET", "/schema")
+        names = {i["name"]: {f["name"] for f in i.get("fields", [])}
+                 for i in schema["indexes"]}
+        assert "nf" in names.get("ci", set()), names
+        assert "g" in names.get("ci2", set()), names
+        # and pre-fault data still serves
+        assert _query(ports[1], "ci", "Count(Row(cf=1))")["results"][0] == 1
+        elapsed = time.time() - t0
+        assert elapsed < 590, "test outlived the parked anti-entropy loop"
     finally:
         procs.stop_all()

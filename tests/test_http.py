@@ -318,3 +318,48 @@ class TestBinaryImport:
                  b"Count(Row(f=0))Count(Row(f=1))",
                  content_type="text/plain")
         assert r["results"] == [2, 1]
+
+
+def test_debug_profile_and_memory_under_load(srv):
+    """/debug/profile samples a live serving process (non-empty stacks
+    while queries run) and /debug/memory accounts the host mirrors —
+    the net/http/pprof role (reference http/handler.go:280)."""
+    import threading
+
+    call(srv, "POST", "/index/p", {"options": {}})
+    call(srv, "POST", "/index/p/field/f", {"options": {"type": "set"}})
+    call(srv, "POST", "/index/p/query", b"Set(1, f=1) Set(2, f=2)",
+         content_type="text/plain")
+
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            call(srv, "POST", "/index/p/query",
+                 b"Count(Intersect(Row(f=1), Row(f=2)))",
+                 content_type="text/plain")
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        prof = call(srv, "GET", "/debug/profile?seconds=0.6&interval_ms=2")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert prof["samples"] > 0
+    assert prof["stacks"], "no stacks sampled"
+    # the hammer thread must be visible in at least one collapsed stack
+    joined = "\n".join(prof["stacks"])
+    assert "executor" in joined or "http" in joined, joined[:500]
+
+    mem = call(srv, "GET", "/debug/memory")
+    assert mem["rss_bytes"] > 0
+    assert mem["host_mirrors"]["fragments"] >= 1
+    assert mem["host_mirrors"]["total_bytes"] > 0
+    assert mem["host_mirrors"]["by_index"]["p"] > 0
+    assert "hbm_budget" in mem and "used_bytes" in mem["hbm_budget"]
+    # bad params are a 400, not a 500
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "GET", "/debug/profile?seconds=abc")
+    assert e.value.code == 400

@@ -17,7 +17,9 @@ def test_eight_devices_present():
 
 
 def test_mesh_shape():
-    assert mesh_shape_for(8) == (4, 2)
+    # all devices ride the shards axis (the rows factor was collapsed
+    # in r05 — see parallel/mesh.py module docstring)
+    assert mesh_shape_for(8) == (8, 1)
     assert mesh_shape_for(2) == (2, 1)
     assert mesh_shape_for(1) == (1, 1)
 
