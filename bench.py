@@ -514,30 +514,41 @@ def main() -> None:
     # copy syncs lazily on the next query; that is the design, so the
     # steady state pays it once per convergence, not per batch).
     n_batches, batch = (8, 500_000) if accel else (4, 50_000)
-    with tempfile.TemporaryDirectory() as d:
-        sq = SnapshotQueue(workers=2)
-        frag2 = Fragment(n_words=W)
-        store = FragmentFile(frag2, os.path.join(d, "frag"), sq)
-        store.open()
-        frag2.store = store
-        srows = ing_rng.integers(0, 64, size=n_batches * batch).astype(np.uint64)
-        scols = ing_rng.integers(0, W * 32, size=n_batches * batch)
-        t0 = time.perf_counter()
-        for bi in range(n_batches):
-            sl = slice(bi * batch, (bi + 1) * batch)
-            frag2.import_bits(srows[sl], scols[sl])
-        sq.await_all()  # snapshots are part of the steady-state cost
-        # durable-on-host rate: the comparison point for the reference
-        # anchor (the reference is CPU-only; our EXTRA device refresh
-        # below rides a 24 MB/s relay in this environment, which a
-        # production host's 100+ GB/s PCIe/ICI h2d does not resemble)
-        sustained_nodev_bits_s = (n_batches * batch) / (
-            time.perf_counter() - t0
-        )
-        frag2.device_bits()  # converge the serving copy once
-        sustained_bits_s = (n_batches * batch) / (time.perf_counter() - t0)
-        sq.stop()
-        store.close()
+    srows = ing_rng.integers(0, 64, size=n_batches * batch).astype(np.uint64)
+    scols = ing_rng.integers(0, W * 32, size=n_batches * batch)
+    # best of 2 full runs (same noise discipline as the cold burst: the
+    # shared host's bandwidth swings 2-10 GB/s between minutes and this
+    # path is bandwidth-heavy)
+    sustained_nodev_bits_s = 0.0
+    sustained_bits_s = 0.0
+    for _ in range(2):
+        with tempfile.TemporaryDirectory() as d:
+            sq = SnapshotQueue(workers=2)
+            frag2 = Fragment(n_words=W)
+            store = FragmentFile(frag2, os.path.join(d, "frag"), sq)
+            store.open()
+            frag2.store = store
+            t0 = time.perf_counter()
+            for bi in range(n_batches):
+                sl = slice(bi * batch, (bi + 1) * batch)
+                frag2.import_bits(srows[sl], scols[sl])
+            sq.await_all()  # snapshots are part of the steady-state cost
+            # durable-on-host rate: the comparison point for the
+            # reference anchor (the reference is CPU-only; our EXTRA
+            # device refresh below rides a 24 MB/s relay in this
+            # environment, which a production host's 100+ GB/s PCIe/ICI
+            # h2d does not resemble)
+            sustained_nodev_bits_s = max(
+                sustained_nodev_bits_s,
+                (n_batches * batch) / (time.perf_counter() - t0),
+            )
+            frag2.device_bits()  # converge the serving copy once
+            sustained_bits_s = max(
+                sustained_bits_s,
+                (n_batches * batch) / (time.perf_counter() - t0),
+            )
+            sq.stop()
+            store.close()
 
     # CPU anchor for ingest (vs_baseline): the same semantic work —
     # dedup + mirror merge + changed-position extraction + checksummed
@@ -618,31 +629,37 @@ def main() -> None:
             # MaxOpN=10000, so the reference pays a full snapshot per
             # batch (fragment.go:2283-2293 incrementOpN -> snapshot)
             width64 = np.uint64(W * 32)
-            with tempfile.TemporaryDirectory() as dr:
-                with _refanchor.RefBitmap() as rb:
-                    opw = open(os.path.join(dr, "ops"), "ab")
-                    t0 = time.perf_counter()
-                    for bi in range(n_batches):
-                        sl = slice(bi * batch, (bi + 1) * batch)
-                        pos = np.unique(
-                            srows[sl] * width64
-                            + scols[sl].astype(np.uint64)
-                        )
-                        rb.addn_sorted(pos)
-                        # the reference also appends an opTypeAddBatch
-                        # record per AddN (roaring.go:248-265, 8 bytes
-                        # per changed bit, page-cache only)
-                        opw.write(pos.tobytes())
-                        opw.flush()
-                        for r in np.unique(srows[sl]):
-                            rb.count_range(
-                                int(r) * W * 32, (int(r) + 1) * W * 32
+            ref_sustained_bits_s = 0.0
+            for _ in range(2):  # best-of, symmetric with the repo side
+                with tempfile.TemporaryDirectory() as dr:
+                    with _refanchor.RefBitmap() as rb:
+                        opw = open(os.path.join(dr, "ops"), "ab")
+                        t0 = time.perf_counter()
+                        for bi in range(n_batches):
+                            sl = slice(bi * batch, (bi + 1) * batch)
+                            pos = np.unique(
+                                srows[sl] * width64
+                                + scols[sl].astype(np.uint64)
                             )
-                        rb.snapshot(os.path.join(dr, "snap"))
-                    ref_sustained_bits_s = (n_batches * batch) / (
-                        time.perf_counter() - t0
-                    )
-                    opw.close()
+                            rb.addn_sorted(pos)
+                            # the reference also appends an
+                            # opTypeAddBatch record per AddN
+                            # (roaring.go:248-265, 8 bytes per changed
+                            # bit, page-cache only)
+                            opw.write(pos.tobytes())
+                            opw.flush()
+                            for r in np.unique(srows[sl]):
+                                rb.count_range(
+                                    int(r) * W * 32,
+                                    (int(r) + 1) * W * 32,
+                                )
+                            rb.snapshot(os.path.join(dr, "snap"))
+                        ref_sustained_bits_s = max(
+                            ref_sustained_bits_s,
+                            (n_batches * batch)
+                            / (time.perf_counter() - t0),
+                        )
+                        opw.close()
             # sequential query: S pseudo-shards of the real row pair
             # (25% density -> bitmap containers; one query walks the
             # same ~42 MB the host tier streams), counted in ONE native
