@@ -258,11 +258,81 @@ def compiled(sig, count_mode: bool):
     return run, n_leaves
 
 
+@lru_cache(maxsize=256)
+def _compiled_spanning(sig, mesh, axis, chunk, n_stacks):
+    """jit(shard_map) count-batch program for a PROCESS-SPANNING mesh:
+    per-shard partials are not host addressable there, so each device
+    evaluates the tree over its local shard block in ``chunk``-shard
+    slices and the reduce is an in-program chunked psum with (hi, lo)
+    uint32 carry-save (exact past int32 — the same machinery as
+    ops/kernels.py's spanning pair/gram kinds).  Returns replicated
+    (hi, lo) uint32[B] arrays."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.ops import kernels as _k
+
+    ctr = [0]
+    root = _build(sig, ctr)
+    n_leaves = ctr[0]
+
+    def local(*args):
+        *stks, slots_b = args
+
+        def part(*blks):
+            def body(_, sl):
+                words = root(tuple(blks), sl)
+                return None, jnp.sum(
+                    lax.population_count(words).astype(jnp.int32), axis=-1
+                )
+
+            _, counts = lax.scan(body, None, slots_b)  # [B, S_chunk]
+            return counts.sum(axis=1)  # [B] int32, chunk-bounded
+
+        return _k._carry_psum_chunks(part, tuple(stks), axis, chunk)
+
+    in_specs = tuple(P(axis, None, None) for _ in range(n_stacks)) + (
+        P(None),
+    )
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(None), P(None)),
+            check_vma=False,
+        )
+    )
+    return fn, n_leaves
+
+
 def run_count_batch(sig, stacks: tuple, slots_np: np.ndarray) -> np.ndarray:
     """One launch: int64 totals for a batch of same-shape Counts.
     ``slots_np`` is int32 [B, L] (pad rows with -1 slots are fine — they
-    count zero and callers slice them off)."""
+    count zero and callers slice them off).  Local stacks sum [B, S]
+    partials host-side; process-spanning stacks reduce in-program and
+    raise ValueError only when totals could exceed int32 even per
+    single-shard psum slice (the row_counts contract)."""
     global launches
+    from pilosa_tpu.ops import kernels as _k
+
+    m = _k.shards_axis_of(stacks[0])
+    if m is not None and _k.mesh_spans_processes(m[0]):
+        mesh, axis = m
+        W = stacks[0].shape[2]
+        chunk = _k._psum_chunk_size(mesh, W)
+        if chunk < 1:
+            raise ValueError(
+                "AST count totals exceed int32 even per single psum"
+                " slice; shrink the shard width or the per-host mesh"
+            )
+        fn, n_leaves = _compiled_spanning(
+            sig, mesh, axis, chunk, len(stacks)
+        )
+        assert slots_np.shape[1] == n_leaves
+        launches += 1
+        hi, lo = fn(*stacks, jnp.asarray(slots_np))
+        return _k._hi_lo_total(hi, lo)
     fn, n_leaves = compiled(sig, True)
     assert slots_np.shape[1] == n_leaves
     launches += 1

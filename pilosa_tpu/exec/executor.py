@@ -883,9 +883,14 @@ class Executor:
         _ABSENT = object()
         stacks_by_view: dict[tuple[str, str], Any] = {}
 
-        def _stacks_for(pairs):
+        def _stacks_for(pairs, allow_spanning):
             """(stacks tuple, slot_of per (field, view)) or None when any
-            leaf declines (cold + under-demanded, or over budget)."""
+            leaf declines (cold + under-demanded, or over budget).
+            ``allow_spanning``: count programs reduce in-program on a
+            process-spanning mesh (astbatch._compiled_spanning), but
+            bitmap programs materialize [S, W] result words for
+            host-side Row segments — not addressable across processes,
+            so those decline."""
             out: list[Any] = []
             slot_maps = {}
             for pair in pairs:
@@ -919,12 +924,9 @@ class Executor:
             real = next((a for a in out if a is not None), None)
             if real is None:
                 return None  # every leaf view absent
-            # the compiled programs return per-shard partials, which are
-            # not host addressable on a process-spanning stack — decline
-            # and let the per-call path serve
             from pilosa_tpu.ops import kernels
 
-            if kernels.stack_spans_processes(real):
+            if not allow_spanning and kernels.stack_spans_processes(real):
                 return None
             return tuple(a if a is not None else real for a in out), slot_maps
 
@@ -936,7 +938,7 @@ class Executor:
             )
 
         for (sig, pairs), items in count_groups.items():
-            st = _stacks_for(pairs)
+            st = _stacks_for(pairs, allow_spanning=True)
             if st is None:
                 continue
             stacks, slot_maps = st
@@ -953,7 +955,7 @@ class Executor:
                 self._count_stat(idx)
 
         for i, sig, pairs, leaves in bitmap_items:
-            st = _stacks_for(pairs)
+            st = _stacks_for(pairs, allow_spanning=False)
             if st is None:
                 continue
             stacks, slot_maps = st

@@ -627,8 +627,9 @@ def stack_spans_processes(x) -> bool:
     """Whether ``x`` is a shards-sharded stack whose mesh includes other
     processes' devices.  The decline guard for the remaining batched
     paths whose kernels return per-shard partials (not host addressable
-    there) — the compiled-AST programs and the k-level GroupBy combo
-    engine; pair/masked/row counts and the grams now reduce in-program
+    there) — the compiled-AST BITMAP programs (host-side Row segments)
+    and the k-level GroupBy combo engine; pair/masked/row counts, the
+    grams, and the compiled-AST COUNT programs now reduce in-program
     (psum) on spanning meshes instead of declining."""
     m = shards_axis_of(x)
     return m is not None and mesh_spans_processes(m[0])

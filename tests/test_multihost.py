@@ -211,6 +211,21 @@ top = sorted(range(R), key=lambda r: (-mc[r], r))[:3]
 want_top = sorted(range(R), key=lambda r: (-want_m[r], r))[:3]
 assert top == want_top
 
+# compiled-AST count programs on the spanning stack (astbatch r05):
+# replicated int64 totals via the in-program chunked psum
+from pilosa_tpu.exec import astbatch
+
+sig = ("intersect", ("row", 0), ("row", 0))
+slots = np.array([[0, 1], [2, 3], [1, 4], [-1, 2]], np.int32)
+tot = astbatch.run_count_batch(sig, (gbits,), slots)
+want_t = [int(want_gram[0, 1]), int(want_gram[2, 3]), int(want_gram[1, 4]), 0]
+assert tot.tolist() == want_t, (tot.tolist(), want_t)
+
+sig3 = ("union", ("row", 0), ("row", 0), ("row", 0))
+tot3 = astbatch.run_count_batch(sig3, (gbits,), np.array([[0, 1, 2]], np.int32))
+want_u3 = len(byrow[0] | byrow[1] | byrow[2])
+assert tot3.tolist() == [want_u3], (tot3.tolist(), want_u3)
+
 # chunked carry-save path: a larger synthetic stack whose totals are
 # declared int32-UNSAFE by shrinking the accumulator limit, forcing
 # per-chunk psums combined as uint32 (hi, lo) pairs
