@@ -225,6 +225,21 @@ def _build(sig, ctr: list[int]):
     return node
 
 
+def _count_scan(root, stacks, slots_b):
+    """int32 [B, S] per-shard counts for a slot batch: on-device scan
+    over the batch, no [B, S, W] materialization.  Shared by the local
+    and spanning compiled programs so count semantics cannot diverge."""
+
+    def body(_, sl):
+        words = root(stacks, sl)
+        return None, jnp.sum(
+            lax.population_count(words).astype(jnp.int32), axis=-1
+        )
+
+    _, counts = lax.scan(body, None, slots_b)
+    return counts
+
+
 @lru_cache(maxsize=256)
 def compiled(sig, count_mode: bool):
     """(jitted_fn, n_leaves) for an AST shape.  ``count_mode`` programs
@@ -240,14 +255,7 @@ def compiled(sig, count_mode: bool):
 
         @jax.jit
         def run(stacks, slots_b):
-            def body(_, sl):
-                words = root(stacks, sl)
-                return None, jnp.sum(
-                    lax.population_count(words).astype(jnp.int32), axis=-1
-                )
-
-            _, counts = lax.scan(body, None, slots_b)
-            return counts  # [B, S]
+            return _count_scan(root, stacks, slots_b)  # [B, S]
 
     else:
 
@@ -280,14 +288,8 @@ def _compiled_spanning(sig, mesh, axis, chunk, n_stacks):
         *stks, slots_b = args
 
         def part(*blks):
-            def body(_, sl):
-                words = root(tuple(blks), sl)
-                return None, jnp.sum(
-                    lax.population_count(words).astype(jnp.int32), axis=-1
-                )
-
-            _, counts = lax.scan(body, None, slots_b)  # [B, S_chunk]
-            return counts.sum(axis=1)  # [B] int32, chunk-bounded
+            # [B, S_chunk] -> [B] int32, chunk-bounded by construction
+            return _count_scan(root, tuple(blks), slots_b).sum(axis=1)
 
         return _k._carry_psum_chunks(part, tuple(stks), axis, chunk)
 

@@ -942,6 +942,14 @@ class Executor:
             if st is None:
                 continue
             stacks, slot_maps = st
+            # same availability contract as every spanning lane: when
+            # even a single-shard psum slice could overflow int32,
+            # DECLINE to the per-call path instead of letting
+            # run_count_batch's ValueError reach the client
+            from pilosa_tpu.ops import kernels as _kk
+
+            if not _kk.row_counts_supported(stacks[0]):
+                continue
             B = _pow2(len(items))
             slots = np.full((B, len(items[0][1])), -1, np.int32)
             for j, (_, leaves) in enumerate(items):
