@@ -152,3 +152,144 @@ def test_fuzz_corrupt_inputs_dont_crash():
         if res is not None:
             positions, _ = res
             assert positions.dtype == np.uint64
+
+
+class TestSerializeWords:
+    """rt_serialize_words (the snapshot hot path) must be byte-identical
+    to the positions pipeline for every container type and row width."""
+
+    def _positions_of(self, rows, n_words):
+        from pilosa_tpu.ops import bitops
+
+        parts = [
+            bitops.unpack_columns(w)
+            + np.uint64(r) * np.uint64(n_words * 32)
+            for r, w in rows
+        ]
+        return np.sort(np.concatenate(parts)) if parts else np.empty(
+            0, np.uint64
+        )
+
+    def _check(self, rows, n_words):
+        row_ids = np.array([r for r, _ in rows], dtype=np.uint64)
+        words = (
+            np.stack([w for _, w in rows])
+            if rows
+            else np.empty((0, n_words), np.uint32)
+        )
+        got = roaring.serialize_rows(row_ids, words)
+        want = roaring.serialize(self._positions_of(rows, n_words))
+        assert got == want
+
+    def test_aligned_width_all_container_types(self):
+        # n_words % 2048 == 0: the container-aligned fast path
+        rng = np.random.default_rng(7)
+        nw = 4096  # 2 containers per row
+        sparse = np.zeros(nw, np.uint32)
+        idx = rng.choice(nw * 32, 300, replace=False)
+        np.bitwise_or.at(
+            sparse, idx // 32, np.uint32(1) << (idx % 32).astype(np.uint32)
+        )
+        dense = rng.integers(0, 2**32, size=nw, dtype=np.uint32)
+        runs = np.zeros(nw, np.uint32)
+        runs[100:600] = 0xFFFFFFFF
+        empty = np.zeros(nw, np.uint32)
+        self._check(
+            [(0, sparse), (3, dense), (9, runs), (11, empty),
+             (2**40, dense)],
+            nw,
+        )
+
+    def test_narrow_width_rows_share_containers(self):
+        # n_words % 2048 != 0: rows pack into shared containers via the
+        # streaming path
+        rng = np.random.default_rng(9)
+        nw = 512  # 2^14 bits/row: 4 rows per 65536-bit container
+        rows = [
+            (r, rng.integers(0, 2**32, size=nw, dtype=np.uint32)
+             & rng.integers(0, 2**32, size=nw, dtype=np.uint32))
+            for r in range(6)
+        ]
+        self._check(rows, nw)
+
+    def test_empty(self):
+        self._check([], 2048)
+
+
+class TestImportMergeParity:
+    """ph_import_merge (native one-pass import) vs the numpy fallback:
+    identical changed counts and mirror state for set and clear, on both
+    the id-keyed fast path and the compact-key (huge hashed row ids)
+    path."""
+
+    def _pair(self, rows, cols, monkeypatch):
+        import pilosa_tpu.ops._hostops as ho
+        from pilosa_tpu.core.fragment import Fragment
+
+        # the class-level skip gates on the CODEC library; this class
+        # exercises the separate hostops library — a hostops build
+        # failure must fail loudly, not silently compare numpy to numpy
+        assert ho.load() is not None, "hostops library unavailable"
+        f_native = Fragment(n_words=256)
+        n_native = f_native.import_bits(rows.copy(), cols.copy())
+        # force the numpy fallback for the twin
+        monkeypatch.setattr(ho, "load", lambda: None)
+        f_numpy = Fragment(n_words=256)
+        n_numpy = f_numpy.import_bits(rows.copy(), cols.copy())
+        monkeypatch.undo()
+        return f_native, n_native, f_numpy, n_numpy
+
+    def _assert_same(self, f_a, f_b, rows):
+        for r in np.unique(rows):
+            np.testing.assert_array_equal(
+                f_a.row_words_host(int(r)), f_b.row_words_host(int(r))
+            )
+
+    def test_set_and_clear_small_ids(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        rows = rng.integers(0, 40, size=5000).astype(np.uint64)
+        cols = rng.integers(0, 256 * 32, size=5000).astype(np.uint64)
+        fa, na, fb, nb = self._pair(rows, cols, monkeypatch)
+        assert na == nb
+        self._assert_same(fa, fb, rows)
+        import pilosa_tpu.ops._hostops as ho
+
+        crows, ccols = rows[:2000], cols[:2000]
+        ca = fa.import_bits(crows.copy(), ccols.copy(), clear=True)
+        monkeypatch.setattr(ho, "load", lambda: None)
+        cb = fb.import_bits(crows.copy(), ccols.copy(), clear=True)
+        monkeypatch.undo()
+        assert ca == cb
+        self._assert_same(fa, fb, rows)
+
+    def test_huge_hashed_row_ids_compact_path(self, monkeypatch):
+        # row ids too large for id*width to fit int63: the compact-key
+        # path (searchsorted inverse) must engage and agree
+        rng = np.random.default_rng(6)
+        base = np.uint64(2**55)
+        rows = (base + rng.integers(0, 5, size=3000).astype(np.uint64))
+        cols = rng.integers(0, 256 * 32, size=3000).astype(np.uint64)
+        fa, na, fb, nb = self._pair(rows, cols, monkeypatch)
+        assert na == nb and na > 0
+        self._assert_same(fa, fb, rows)
+
+    def test_maintained_counts_carry(self):
+        # per-row changed counts from the native pass must keep the
+        # maintained TopN counts exact across a second import
+        from pilosa_tpu.core.fragment import Fragment
+
+        rng = np.random.default_rng(8)
+        f = Fragment(n_words=256)
+        rows = rng.integers(0, 8, size=2000).astype(np.uint64)
+        cols = rng.integers(0, 256 * 32, size=2000).astype(np.uint64)
+        f.import_bits(rows, cols)
+        _ = f.row_counts()  # build counts
+        f.import_bits(
+            rng.integers(0, 8, size=500).astype(np.uint64),
+            rng.integers(0, 256 * 32, size=500).astype(np.uint64),
+        )
+        assert f._counts is not None  # carried, not invalidated
+        ids, counts = f.row_counts()
+        for r, c in zip(ids, counts.tolist()):
+            want = int(np.bitwise_count(f.row_words_host(int(r))).sum())
+            assert c == want, r
