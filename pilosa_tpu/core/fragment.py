@@ -127,7 +127,7 @@ class Fragment:
         self._lock = threading.RLock()
         self._slot_of: dict[int, int] = {}  # row id -> slot
         self._rowids: list[int] = []  # slot -> row id
-        self._host = np.zeros((0, n_words), dtype=np.uint32)
+        self._set_host(np.zeros((0, n_words), dtype=np.uint32))
         self._device: jax.Array | None = None
         self._dirty: set[int] = set()
         # word-granular change tracking riding alongside _dirty: flat
@@ -167,6 +167,16 @@ class Fragment:
         self._evict_pending = False
         self._delta_reset()
 
+    def _set_host(self, arr: np.ndarray) -> None:
+        """The ONLY way to (re)assign the host mirror: keeps the cached
+        base address in lockstep (the latency tier builds 100+ row
+        addresses per query off ``_host_addr``; __array_interface__
+        costs ~1 us per access vs ~60 ns for the attribute — and a
+        reassignment that forgot the pair would hand the native kernel
+        a pointer into the freed old buffer)."""
+        self._host = arr
+        self._host_addr = arr.__array_interface__["data"][0]
+
     # -- row bookkeeping ----------------------------------------------------
 
     @property
@@ -190,7 +200,7 @@ class Fragment:
         if cap != self.capacity:
             grown = np.zeros((cap, self.n_words), dtype=np.uint32)
             grown[: self.capacity] = self._host
-            self._host = grown
+            self._set_host(grown)
             self._drop_device()  # full re-upload on next query
 
     def _slots_batch(self, row_ids: np.ndarray) -> np.ndarray:
@@ -1125,7 +1135,7 @@ class Fragment:
         with self._lock:
             self._slot_of.clear()
             self._rowids.clear()
-            self._host = np.zeros((0, self.n_words), dtype=np.uint32)
+            self._set_host(np.zeros((0, self.n_words), dtype=np.uint32))
             self._drop_device()
             self._counts = None
             self.version += 1

@@ -1663,9 +1663,8 @@ class Executor:
         with contextlib.ExitStack() as st:
             for i, f in enumerate(frags):
                 st.enter_context(f._lock)
-                host = f._host
-                hosts.append(host)
-                bases[i] = host.__array_interface__["data"][0]
+                hosts.append(f._host)  # keep alive through the call
+                bases[i] = f._host_addr  # maintained at _host reassignment
                 sa = f._slot_of.get(ra)
                 sb = f._slot_of.get(rb)
                 slots_a[i] = -1 if sa is None else sa

@@ -562,3 +562,50 @@ class TestAttrBlockPersistence:
         for i in range(10):
             assert s.attrs(i * ATTR_BLOCK_SIZE) == {"n": i}
         assert sorted(s.ids()) == [i * ATTR_BLOCK_SIZE for i in range(10)]
+
+
+class TestWalFsyncPolicy:
+    """PILOSA_TPU_WAL_FSYNC: "snapshot" (default, reference durability
+    parity — op appends never fsync, only snapshot files do) vs "batch"
+    (fsync every WAL batch)."""
+
+    def _count_fsyncs(self, monkeypatch, policy):
+        import pilosa_tpu.storage.fragmentfile as ff
+        from pilosa_tpu.core.fragment import Fragment
+
+        calls = {"n": 0}
+        real = ff.os.fsync
+
+        def counting(fd):
+            calls["n"] += 1
+            return real(fd)
+
+        monkeypatch.setattr(ff.os, "fsync", counting)
+        monkeypatch.setattr(ff, "WAL_FSYNC", policy)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            frag = Fragment(n_words=64)
+            store = ff.FragmentFile(frag, os.path.join(d, "frag"))
+            store.open()  # attaches itself as frag.store
+            rng = np.random.default_rng(3)
+            for _ in range(4):  # 4 WAL batches, no snapshot (< MAX_OP_N)
+                frag.import_bits(
+                    rng.integers(0, 4, size=50).astype("uint64"),
+                    rng.integers(0, 64 * 32, size=50).astype("uint64"),
+                )
+            before_snapshot = calls["n"]
+            store.snapshot()
+            after_snapshot = calls["n"]
+            store.close()
+        return before_snapshot, after_snapshot
+
+    def test_snapshot_policy_skips_wal_fsync(self, monkeypatch):
+        wal, total = self._count_fsyncs(monkeypatch, "snapshot")
+        assert wal == 0  # op appends: page cache only (reference parity)
+        assert total >= 1  # the snapshot file IS fsynced
+
+    def test_batch_policy_fsyncs_every_batch(self, monkeypatch):
+        wal, total = self._count_fsyncs(monkeypatch, "batch")
+        assert wal >= 4  # one per WAL batch at least
+        assert total > wal
