@@ -293,3 +293,42 @@ class TestImportMergeParity:
         for r, c in zip(ids, counts.tolist()):
             want = int(np.bitwise_count(f.row_words_host(int(r))).sum())
             assert c == want, r
+
+
+def test_fuzz_import_merge_differential(monkeypatch):
+    """Differential fuzz: random (shape, id regime, set/clear
+    interleaving) sequences must leave the native and numpy import
+    paths with identical mirrors and changed counts."""
+    import pilosa_tpu.ops._hostops as ho
+    from pilosa_tpu.core.fragment import Fragment
+
+    assert ho.load() is not None, "hostops library unavailable"
+    root_rng = np.random.default_rng(0xF00D)
+    for case in range(12):
+        n_words = int(root_rng.choice([32, 64, 256, 2048]))
+        width = n_words * 32
+        if case % 3 == 2:
+            row_base = np.uint64(2**55)  # compact-key path
+        else:
+            row_base = np.uint64(0)  # id-keyed fast path
+        n_rows = int(root_rng.integers(1, 60))
+        f_nat = Fragment(n_words=n_words)
+        f_np = Fragment(n_words=n_words)
+        for step in range(int(root_rng.integers(1, 5))):
+            n = int(root_rng.integers(1, 4000))
+            rows = row_base + root_rng.integers(
+                0, n_rows, size=n
+            ).astype(np.uint64)
+            cols = root_rng.integers(0, width, size=n).astype(np.uint64)
+            clear = bool(root_rng.integers(0, 2)) and step > 0
+            a = f_nat.import_bits(rows.copy(), cols.copy(), clear=clear)
+            monkeypatch.setattr(ho, "load", lambda: None)
+            b = f_np.import_bits(rows.copy(), cols.copy(), clear=clear)
+            monkeypatch.undo()
+            assert a == b, (case, step, a, b)
+            for r in np.unique(rows):
+                np.testing.assert_array_equal(
+                    f_nat.row_words_host(int(r)),
+                    f_np.row_words_host(int(r)),
+                    err_msg=f"case {case} step {step} row {r}",
+                )
