@@ -214,6 +214,16 @@ int64_t ph_import_merge(const int64_t* keys, size_t n, int64_t width,
                     if (row_ids[mid] < rid) lo = mid + 1;
                     else hi = mid;
                 }
+                if (lo >= n_rows || row_ids[lo] != rid) {
+                    // row id absent from the fragment's row table: a
+                    // caller invariant break.  Skip this row run rather
+                    // than index slots[]/row_ids[] out of bounds.
+                    ri = -1;
+                    row_lo = row_of_k * width;
+                    row_hi = row_lo + width;
+                    row_base = nullptr;
+                    continue;
+                }
                 ri = static_cast<int64_t>(lo);
             } else {
                 ri = row_of_k;
@@ -223,6 +233,7 @@ int64_t ph_import_merge(const int64_t* keys, size_t n, int64_t width,
             row_base = m32 + slots[ri] * n_words;
             wal_base = row_ids[ri] * static_cast<uint64_t>(width);
         }
+        if (row_base == nullptr) continue;  // inside a skipped row run
         int64_t col = k - row_lo;
         int64_t w = col >> 5;
         uint32_t bit = 1u << (col & 31);

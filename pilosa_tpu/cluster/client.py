@@ -370,6 +370,16 @@ class InternalClient:
         cluster.go:1699-1726 probes /version)."""
         return self._json("GET", uri, "/version")
 
+    def shards_max(self, uri: str) -> dict:
+        """Per-index max shard seen by ``uri`` (reference
+        client.go:176 MaxShardByIndex)."""
+        return self._json("GET", uri, "/internal/shards/max")
+
+    def nodes(self, uri: str) -> list:
+        """Cluster node list as seen by ``uri`` (reference
+        client.go:139 Nodes)."""
+        return self._json("GET", uri, "/internal/nodes")
+
     def translate_keys(
         self, uri: str, index: str, field: str | None, keys: list[str]
     ) -> list[int]:
@@ -450,6 +460,12 @@ class NopInternalClient:
 
     def version(self, uri):
         return {}
+
+    def shards_max(self, uri):
+        return {}
+
+    def nodes(self, uri):
+        return []
 
     def translate_keys(self, uri, index, field, keys):
         return []

@@ -1,0 +1,26 @@
+"""Version shims for the JAX APIs this project sits on.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+top level, and its replication-check kwarg was renamed ``check_rep`` ->
+``check_vma`` along the way.  Kernel code writes the modern spelling
+(``from pilosa_tpu.compat import shard_map`` + ``check_vma=``) and this
+wrapper translates for older runtimes.
+"""
+
+from __future__ import annotations
+
+try:  # modern jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    kwargs = {_CHECK_KW: check_vma}
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

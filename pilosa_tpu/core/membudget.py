@@ -39,6 +39,7 @@ class DeviceBudget:
         # counters for stats/diagnostics
         self.evictions = 0
         self.admissions = 0
+        self.evict_errors = 0
 
     def used(self) -> int:
         with self._lock:
@@ -78,7 +79,9 @@ class DeviceBudget:
             try:
                 cb()
             except Exception:
-                pass  # eviction is advisory; owner may already be gone
+                # eviction is advisory; owner may already be gone —
+                # counted so a flaky callback is visible in diagnostics
+                self.evict_errors += 1
 
     def touch(self, key) -> None:
         with self._lock:

@@ -769,6 +769,11 @@ class Executor:
                 # (summed host-side in int64 so totals past 2^31 stay
                 # exact); process-spanning stacks return replicated
                 # int64[B] in-program psum totals (kernels.py r05).
+                if not kernels.row_counts_supported(bits):
+                    # spanning mesh too large even for the chunked psum
+                    # — leave these results unset so the per-call
+                    # per-fragment path answers them
+                    continue
                 by_op: dict[str, list[tuple[int, int, int]]] = {}
                 for i, op, sa, sb in launch:
                     by_op.setdefault(op, []).append((i, sa, sb))
@@ -2462,6 +2467,13 @@ class Executor:
                 # stacks return replicated int64[B] in-program psum
                 # totals (kernels.py r05 — the fast lane no longer
                 # declines across hosts)
+                if not kernels.row_counts_supported(bits1) or (
+                    f2 is not f1
+                    and not kernels.row_counts_supported(bits2)
+                ):
+                    # spanning mesh too large even for the chunked
+                    # psum: decline so the recursive path answers it
+                    return None
                 combos_s = [
                     (slot1[r1], slot2[r2])
                     for r1 in present1

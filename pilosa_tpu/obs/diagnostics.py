@@ -39,6 +39,7 @@ class Diagnostics:
         self._extra: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.flush_errors = 0
 
     def set(self, key: str, value) -> None:
         """reference diagnostics.Set — arbitrary reported fields."""
@@ -102,7 +103,9 @@ class Diagnostics:
                 try:
                     self.flush()
                 except Exception:
-                    pass
+                    # the reporter loop must survive a bad flush; the
+                    # counter keeps the failure visible in the report
+                    self.flush_errors += 1
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

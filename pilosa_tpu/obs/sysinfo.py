@@ -190,7 +190,8 @@ class RuntimeMonitor:
                 try:
                     self.poll_once()
                 except Exception:
-                    pass
+                    # keep polling; a failed sample is itself a metric
+                    self.stats.count("metric_poll_errors", 1)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

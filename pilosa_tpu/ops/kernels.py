@@ -48,8 +48,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.compat import shard_map
 
 from pilosa_tpu.ops.bitops import pow2_pad_len
 

@@ -172,7 +172,7 @@ class NodeServer:
         except Exception:
             # Shard availability re-converges via node status exchange;
             # a failed advisory broadcast must not fail the write path.
-            pass
+            self.holder.stats.count("broadcast_errors", 1)
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -85,6 +85,8 @@ class AttrBlocksDir:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump({str(k): v for k, v in data.items()}, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
 
 

@@ -353,6 +353,14 @@ class FragmentFile:
         with self._lock:
             self._closed = True
             if self._fh is not None:
+                # Under WAL_FSYNC='snapshot' appended ops are only
+                # flushed to the page cache; a crash right after a clean
+                # close would lose the op-log tail.  Sync on the way out.
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError):
+                    pass  # best-effort: close() must not raise on shutdown
                 self._fh.close()
                 self._fh = None
 

@@ -614,3 +614,51 @@ def test_recreated_fragment_never_aliases_cached_stack(setup):
     assert frag.version >= v_old
     after = ex.execute("i", q)[0]
     assert after == before + 1  # rebuilt from the NEW object's bits
+
+
+class TestSpanningMeshDecline:
+    """When row_counts_supported is False — a process-spanning mesh so
+    tall (>2047 devices at full width) that even the chunked in-program
+    psum would overflow int32 — the gram-declined batched scan lanes
+    must fall through to the per-fragment paths, not launch anyway."""
+
+    def _force_unsupported(self, monkeypatch):
+        from pilosa_tpu.ops import kernels
+
+        monkeypatch.setattr(kernels, "row_counts_supported", lambda bits: False)
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "batched pair scan must decline on an unsupported mesh"
+            )
+
+        monkeypatch.setattr(kernels, "pair_count_batched", boom)
+        monkeypatch.setattr(kernels, "pair_count_two_batched", boom)
+
+    def test_pair_scan_declines_to_per_call(self, setup, monkeypatch):
+        from pilosa_tpu.exec.executor import Executor
+
+        _, ex = setup
+        pairs = [(0, 1), (2, 3), (4, 5)]
+        want = [ex.execute("i", _pairs_query([p]))[0] for p in pairs]
+        # gram declines (as if > GRAM_MAX_ROWS distinct rows) ...
+        monkeypatch.setattr(
+            Executor, "_field_gram", lambda self, f, bits, uniq: (None, None)
+        )
+        # ... and the mocked mesh rejects the scan lane too
+        self._force_unsupported(monkeypatch)
+        assert ex.execute("i", _pairs_query(pairs)) == want
+
+    def test_groupby_batch_declines_to_recursion(self, setup, monkeypatch):
+        from pilosa_tpu.exec.executor import Executor
+
+        _, ex = setup
+        q = "GroupBy(Rows(f), Rows(g))"
+        want = ex.execute("i", q)[0]
+        assert want  # non-trivial combos
+        monkeypatch.setattr(
+            Executor, "_field_gram", lambda self, f, bits, uniq: (None, None)
+        )
+        monkeypatch.setattr(Executor, "_cross_gram", lambda *a, **k: None)
+        self._force_unsupported(monkeypatch)
+        assert ex.execute("i", q)[0] == want

@@ -1,0 +1,167 @@
+"""graftlint self-tests: every pass fires on its bad corpus and stays
+silent on the good twin; suppression reasons are mandatory; the real
+tree is clean (zero unsuppressed findings)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftlint import engine
+from tools.graftlint.passes import ALL_PASSES, BY_ID
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tools", "graftlint", "corpus")
+
+PER_FILE = [
+    "tpu_purity",
+    "dtype_discipline",
+    "lock_discipline",
+    "durability",
+    "exception_hygiene",
+]
+
+
+def _check_corpus_file(pass_mod, kind):
+    path = os.path.join(CORPUS, pass_mod, f"{kind}.py")
+    tree, lines, err = engine.parse_file(path)
+    assert err is None, err
+    p = BY_ID[pass_mod.replace("_", "-")]
+    return p.check(path, tree, lines)
+
+
+@pytest.mark.parametrize("pass_mod", PER_FILE)
+def test_bad_corpus_fires(pass_mod):
+    findings = _check_corpus_file(pass_mod, "bad")
+    assert findings, f"{pass_mod} found nothing in its bad corpus"
+    assert all(f.pass_id == pass_mod.replace("_", "-") for f in findings)
+
+
+@pytest.mark.parametrize("pass_mod", PER_FILE)
+def test_good_corpus_clean(pass_mod):
+    assert _check_corpus_file(pass_mod, "good") == []
+
+
+class TestBadCorpusCoverage:
+    """The bad files must exercise every violation *class*, not just
+    trip the pass once."""
+
+    def _msgs(self, pass_mod):
+        return [f.message for f in _check_corpus_file(pass_mod, "bad")]
+
+    def test_tpu_purity_classes(self):
+        msgs = " | ".join(self._msgs("tpu_purity"))
+        assert "host numpy" in msgs
+        assert "Python If" in msgs
+        assert "int() coercion" in msgs
+        assert "float() coercion" in msgs
+        assert ".item()" in msgs
+
+    def test_dtype_classes(self):
+        msgs = " | ".join(self._msgs("dtype_discipline"))
+        assert "jnp.int64" in msgs
+        assert "dtype=np.uint64" in msgs
+        assert "dtype='int64'" in msgs
+
+    def test_lock_classes(self):
+        msgs = " | ".join(self._msgs("lock_discipline"))
+        assert "send_message" in msgs
+        assert "time.sleep" in msgs
+        assert "fh.write" in msgs
+
+    def test_durability_classes(self):
+        msgs = " | ".join(self._msgs("durability"))
+        assert "os.replace" in msgs
+        assert "close() releases" in msgs
+
+    def test_exception_classes(self):
+        msgs = " | ".join(self._msgs("exception_hygiene"))
+        assert "bare except" in msgs
+        assert "except Exception" in msgs
+
+
+class TestDispatchParity:
+    def test_bad_tree_fires_both_halves(self):
+        fs = engine.run([os.path.join(CORPUS, "dispatch_parity", "bad")])
+        msgs = " | ".join(
+            f.message for f in fs if f.pass_id == "dispatch-parity"
+        )
+        assert "parser special 'Zap'" in msgs
+        assert "'/internal/orphan'" in msgs
+
+    def test_good_tree_clean(self):
+        fs = engine.run([os.path.join(CORPUS, "dispatch_parity", "good")])
+        assert [f for f in fs if f.pass_id == "dispatch-parity"] == []
+
+
+class TestSuppression:
+    def test_reason_is_mandatory(self):
+        fs = engine.run([os.path.join(CORPUS, "suppression", "bad.py")])
+        ids = sorted(f.pass_id for f in fs)
+        # the reasonless disable does NOT suppress, and is itself flagged
+        assert ids == ["bad-suppression", "exception-hygiene"]
+        assert not any(f.suppressed for f in fs)
+
+    def test_reasoned_disable_closes_finding(self):
+        fs = engine.run([os.path.join(CORPUS, "suppression", "good.py")])
+        [f] = fs
+        assert f.pass_id == "exception-hygiene" and f.suppressed
+        assert "advisory" in f.reason
+
+    def test_bad_suppression_cannot_be_suppressed(self, tmp_path):
+        p = tmp_path / "x.py"
+        p.write_text(
+            "# graftlint: disable-file=bad-suppression -- nope\n"
+            "try:\n    pass\n"
+            "except Exception:  # graftlint: disable=exception-hygiene\n"
+            "    pass\n"
+        )
+        fs = engine.run([str(p)])
+        bad = [f for f in fs if f.pass_id == "bad-suppression"]
+        assert bad and not any(f.suppressed for f in bad)
+
+    def test_docstring_mention_is_not_a_suppression(self, tmp_path):
+        p = tmp_path / "x.py"
+        p.write_text(
+            '"""Docs may say # graftlint: disable=foo freely."""\n'
+        )
+        assert engine.run([str(p)]) == []
+
+
+class TestTreeClean:
+    def test_zero_unsuppressed_findings(self):
+        roots = [os.path.join(REPO, d) for d in ("pilosa_tpu", "tests", "tools")]
+        open_ = [f for f in engine.run(roots) if not f.suppressed]
+        assert open_ == [], "\n".join(f.render() for f in open_)
+
+    def test_every_suppression_has_reason(self):
+        roots = [os.path.join(REPO, d) for d in ("pilosa_tpu", "tests", "tools")]
+        for f in engine.run(roots):
+            if f.suppressed:
+                assert f.reason and f.reason.strip()
+
+
+class TestCLI:
+    def test_exit_codes_and_json(self, tmp_path):
+        out = tmp_path / "report.json"
+        env = dict(os.environ, PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint",
+             "pilosa_tpu", "tests", "tools", "--json", str(out)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        report = json.loads(out.read_text())
+        assert report["open"] == 0
+        assert all(f["suppressed"] for f in report["findings"])
+
+    def test_nonzero_on_findings(self, tmp_path):
+        bad = os.path.join(CORPUS, "exception_hygiene", "bad.py")
+        env = dict(os.environ, PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", bad],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 1

@@ -332,3 +332,70 @@ def test_fuzz_import_merge_differential(monkeypatch):
                     f_np.row_words_host(int(r)),
                     err_msg=f"case {case} step {step} row {r}",
                 )
+
+
+def test_import_merge_absent_row_id_skipped():
+    """id_keys=1: a row id missing from the fragment's sorted row table
+    (caller invariant break) must be skipped — the unguarded binary
+    search used to land on the successor row and corrupt it, or read
+    slots[]/row_ids[] out of bounds past the last row."""
+    import pilosa_tpu.ops._hostops as ho
+
+    assert ho.load() is not None, "hostops library unavailable"
+    n_words = 8
+    width = n_words * 32
+    row_ids = np.array([2, 7, 9], np.uint64)
+    slots = np.arange(3, dtype=np.int64)
+    mirror = np.zeros((4, n_words), np.uint32)
+    # rid 5 falls between table entries; rid 11 is past the end
+    raw = [(2, 1), (2, 40), (5, 3), (5, 99), (9, 7), (11, 0)]
+    keys = np.sort(np.array([r * width + c for r, c in raw], np.int64))
+    nc, wal, perrow, cw = ho.import_merge(
+        keys, width, n_words, slots, row_ids, mirror, False, id_keys=True
+    )
+    assert nc == 3
+    assert wal.tolist() == [2 * width + 1, 2 * width + 40, 9 * width + 7]
+    assert perrow.tolist() == [2, 0, 1]
+    assert cw.tolist() == [0, 1, 2 * n_words + 0]
+    want = np.zeros((4, n_words), np.uint32)
+    want[0, 0] = 1 << 1
+    want[0, 1] = 1 << 8
+    want[2, 0] = 1 << 7
+    np.testing.assert_array_equal(mirror, want)
+
+    # fuzz the skip semantics against a python reference
+    rng = np.random.default_rng(0xABE)
+    for case in range(8):
+        nw = int(rng.choice([4, 8, 32]))
+        w = nw * 32
+        table = np.unique(rng.integers(0, 30, size=rng.integers(1, 10)))
+        table = table.astype(np.uint64)
+        slots_f = np.arange(table.size, dtype=np.int64)
+        mir = np.zeros((table.size + 1, nw), np.uint32)
+        rids = rng.integers(0, 32, size=200).astype(np.int64)  # some absent
+        cols = rng.integers(0, w, size=200).astype(np.int64)
+        ks = np.sort(rids * w + cols)
+        clear = bool(case % 2)
+        if clear:
+            mir[:-1] = 0xFFFFFFFF  # all bits set so clears change things
+        ref = mir.copy()
+        n_ref = 0
+        pos = {int(r): i for i, r in enumerate(table)}
+        for k in ks.tolist():
+            r, c = divmod(int(k), w)
+            if r not in pos:
+                continue
+            word, bit = c >> 5, np.uint32(1 << (c & 31))
+            if clear:
+                if ref[pos[r], word] & bit:
+                    ref[pos[r], word] &= ~bit
+                    n_ref += 1
+            else:
+                if not ref[pos[r], word] & bit:
+                    ref[pos[r], word] |= bit
+                    n_ref += 1
+        got = ho.import_merge(
+            ks, w, nw, slots_f, table, mir, clear, id_keys=True
+        )
+        assert got[0] == n_ref, (case, got[0], n_ref)
+        np.testing.assert_array_equal(mir, ref, err_msg=f"case {case}")

@@ -1,0 +1,28 @@
+"""graftlint — project-specific static analysis for pilosa_tpu.
+
+The Go reference got ``go vet`` and ``go test -race`` for free; this
+Python/JAX port gets neither, and its correctness invariants (TPU trace
+purity, 32-bit dtype discipline, lock ordering around blocking I/O,
+fsync-before-rename durability, executor/parser/route parity) lived only
+in reviewers' heads.  graftlint encodes each one as an AST pass over the
+tree so a violation fails CI instead of shipping.
+
+Run it as a module::
+
+    python -m tools.graftlint pilosa_tpu tests tools
+    python -m tools.graftlint pilosa_tpu --json findings.json
+
+Suppress a finding on its line with a MANDATORY reason::
+
+    x = np.float32(v)  # graftlint: disable=tpu-purity -- static shape math
+
+or for a whole file near the top::
+
+    # graftlint: disable-file=lock-discipline -- single-threaded test helper
+
+A disable comment without a ``-- reason`` is itself a finding.
+
+See docs/graftlint.md for each pass's invariant and how to add one.
+"""
+
+from tools.graftlint.engine import Finding, run, walk_files  # noqa: F401

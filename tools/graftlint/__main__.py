@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.graftlint [roots...] [--json FILE]``.
+
+Exit status: 0 when every finding is suppressed (with a reason), 1 when
+unsuppressed findings remain, 2 on usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.graftlint.engine import run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="project-specific static analysis for pilosa_tpu",
+    )
+    ap.add_argument(
+        "roots", nargs="*", default=["pilosa_tpu"],
+        help="files or directories to lint (default: pilosa_tpu)",
+    )
+    ap.add_argument(
+        "--json", metavar="FILE",
+        help="write machine-readable findings to FILE ('-' for stdout)",
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings",
+    )
+    ap.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        from tools.graftlint.passes import ALL_PASSES
+
+        for p in ALL_PASSES:
+            scope = "project-wide" if getattr(p, "PROJECT", False) else "per-file"
+            print(f"{p.PASS_ID:20s} {scope:12s} {p.DESCRIPTION}")
+        return 0
+
+    findings = run(args.roots)
+    open_findings = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    for f in open_findings:
+        print(f.render())
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f.render())
+
+    if args.json:
+        payload = {
+            "roots": args.roots,
+            "open": len(open_findings),
+            "suppressed": len(suppressed),
+            "findings": [f.to_json() for f in findings],
+        }
+        if args.json == "-":
+            json.dump(payload, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=2)
+
+    print(
+        f"graftlint: {len(open_findings)} finding(s), "
+        f"{len(suppressed)} suppressed",
+        file=sys.stderr,
+    )
+    return 1 if open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

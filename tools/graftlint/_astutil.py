@@ -1,0 +1,49 @@
+"""Small AST helpers shared by the graftlint passes."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested_functions(body: list[ast.stmt]):
+    """Yield every node lexically inside ``body`` WITHOUT descending into
+    nested function/class definitions (their bodies run in a different
+    dynamic context than the enclosing block)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def string_prefix(node: ast.AST) -> str | None:
+    """The leading literal text of a string expression: whole value for a
+    Constant str, the constant prefix for an f-string (formatted fields
+    become ``{}``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out: list[str] = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out.append(part.value)
+            else:
+                out.append("{}")
+        return "".join(out)
+    return None

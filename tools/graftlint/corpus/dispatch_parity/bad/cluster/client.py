@@ -1,0 +1,8 @@
+"""Mini internal client: only fragment_blocks."""
+
+
+class InternalClient:
+    def fragment_blocks(self, uri, index):
+        return self._json(
+            "GET", uri, f"/internal/fragment/blocks?index={index}"
+        )

@@ -1,0 +1,8 @@
+"""Mini executor: only handles Set."""
+
+
+def _execute_call(self, idx, call, shards):
+    name = call.name
+    if name == "Set":
+        return self._execute_set(idx, call)
+    raise ValueError(f"unknown call: {name}")

@@ -1,0 +1,9 @@
+"""Mini parser: 'Zap' is parseable but the executor can't run it."""
+
+
+def call(self):
+    specials = {
+        "Set": self._call_set,
+        "Zap": self._call_zap,
+    }
+    return specials

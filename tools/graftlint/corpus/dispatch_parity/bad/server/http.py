@@ -1,0 +1,8 @@
+"""Mini HTTP router: /internal/orphan has no client method."""
+
+import re
+
+_ROUTES = [
+    ("GET", re.compile(r"^/internal/fragment/blocks$"), "fragment_blocks"),
+    ("POST", re.compile(r"^/internal/orphan$"), "orphan"),
+]

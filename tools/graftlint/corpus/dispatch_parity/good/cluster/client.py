@@ -1,0 +1,13 @@
+"""Mini internal client covering every internal route."""
+
+
+class InternalClient:
+    def fragment_blocks(self, uri, index):
+        return self._json(
+            "GET", uri, f"/internal/fragment/blocks?index={index}"
+        )
+
+    def translate_log(self, uri, offset):
+        return self._json(
+            "GET", uri, f"/internal/translate/log?offset={int(offset)}"
+        )

@@ -1,0 +1,10 @@
+"""Mini executor handling every parser special."""
+
+
+def _execute_call(self, idx, call, shards):
+    name = call.name
+    if name == "Set":
+        return self._execute_set(idx, call)
+    if call.name in ("TopN", "Rows"):
+        return self._execute_topn(idx, call, shards)
+    raise ValueError(f"unknown call: {name}")

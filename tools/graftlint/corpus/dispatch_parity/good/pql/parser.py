@@ -1,0 +1,9 @@
+"""Mini parser whose specials all execute."""
+
+
+def call(self):
+    specials = {
+        "Set": self._call_set,
+        "TopN": self._call_topn,
+    }
+    return specials

@@ -1,0 +1,8 @@
+"""Mini HTTP router fully paired with the client."""
+
+import re
+
+_ROUTES = [
+    ("GET", re.compile(r"^/internal/fragment/blocks$"), "fragment_blocks"),
+    ("GET", re.compile(r"^/internal/translate/log$"), "translate_log"),
+]

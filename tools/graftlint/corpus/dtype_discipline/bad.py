@@ -1,0 +1,12 @@
+"""dtype-discipline bad corpus."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def widens():
+    a = jnp.zeros(4, dtype=jnp.int64)  # aliases int32 with x64 off
+    b = jnp.asarray([1], dtype=np.uint64)  # truncates
+    c = jnp.array([0], dtype="int64")  # string form
+    d = jnp.full(2, 2**40)  # >32-bit literal truncates
+    return a, b, c, d

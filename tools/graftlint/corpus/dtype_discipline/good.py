@@ -1,0 +1,12 @@
+"""dtype-discipline good corpus."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def stays_32bit():
+    a = jnp.zeros(4, dtype=jnp.int32)
+    b = jnp.asarray([1], dtype=jnp.uint32)
+    host = np.array([2**40], dtype=np.uint64)  # host numpy may be wide
+    c = jnp.full(2, 2**31 - 1)
+    return a, b, host, c

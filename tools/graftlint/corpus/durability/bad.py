@@ -1,0 +1,19 @@
+"""durability bad corpus."""
+
+import os
+
+
+class Store:
+    def __init__(self, path):
+        self.path = path
+        self._fh = open(path, "ab")
+
+    def snapshot(self, data):
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self.path)  # rename without fsync
+
+    def close(self):
+        self._fh.close()  # data-file close without fsync
+        self._fh = None

@@ -1,0 +1,23 @@
+"""durability good corpus."""
+
+import os
+
+
+class Store:
+    def __init__(self, path):
+        self.path = path
+        self._fh = open(path, "ab")
+
+    def snapshot(self, data):
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def close(self):
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._fh = None

@@ -1,0 +1,17 @@
+"""exception-hygiene bad corpus."""
+
+
+def worker_loop(queue):
+    while True:
+        item = queue.get()
+        try:
+            item.run()
+        except Exception:
+            pass  # silently swallowed
+
+
+def probe(fn):
+    try:
+        return fn()
+    except:  # bare except, body is a no-op
+        pass

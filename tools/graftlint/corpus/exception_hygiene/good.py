@@ -1,0 +1,22 @@
+"""exception-hygiene good corpus."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def worker_loop(queue, stats):
+    while True:
+        item = queue.get()
+        try:
+            item.run()
+        except Exception:
+            logger.exception("worker item failed")
+            stats.count("worker_errors", 1)
+
+
+def probe(fn):
+    try:
+        return fn()
+    except OSError:
+        pass  # narrow type: fine

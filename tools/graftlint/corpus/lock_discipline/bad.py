@@ -1,0 +1,25 @@
+"""lock-discipline bad corpus."""
+
+import threading
+import time
+
+
+class Node:
+    def __init__(self, client, peers):
+        self._lock = threading.Lock()
+        self.client = client
+        self.peers = peers
+        self.state = {}
+
+    def broadcast(self, msg):
+        with self._lock:
+            for peer in self.peers:
+                self.client.send_message(peer, msg)  # RPC under lock
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)  # sleep under lock
+
+    def persist(self, fh, data):
+        with self._lock:
+            fh.write(data)  # file I/O under lock

@@ -1,0 +1,31 @@
+"""lock-discipline good corpus: copy under the lock, I/O outside."""
+
+import threading
+import time
+
+
+class Node:
+    def __init__(self, client, peers):
+        self._lock = threading.Lock()
+        self.client = client
+        self.peers = peers
+        self.state = {}
+
+    def broadcast(self, msg):
+        with self._lock:
+            peers = list(self.peers)
+        for peer in peers:
+            self.client.send_message(peer, msg)
+
+    def backoff(self):
+        time.sleep(0.5)
+
+    def enqueue_flush(self, fh, data):
+        with self._lock:
+            self.state["pending"] = data
+
+        def flush():
+            # nested def: runs later, NOT under the lock
+            fh.write(data)
+
+        return flush

@@ -1,0 +1,8 @@
+"""Suppression corpus: a disable without a reason is itself a finding."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:  # graftlint: disable=exception-hygiene
+        pass

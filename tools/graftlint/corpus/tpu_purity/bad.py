@@ -1,0 +1,42 @@
+"""tpu-purity bad corpus: every host-escape class inside traced fns."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+
+
+@jax.jit
+def decorated_numpy(x):
+    return np.sum(x)  # host numpy inside jit
+
+
+@partial(jax.jit, static_argnames=("op",))
+def branch_on_traced(x, op):
+    if x > 0:  # Python branch on traced value
+        return x
+    return -x
+
+
+@jax.jit
+def coerces(x):
+    n = int(x)  # concretizes a tracer
+    return x.item() + n  # .item() forces a sync
+
+
+def _inner(a, b):
+    return float(a) + b  # traced via the builder below
+
+
+def builder():
+    return jax.jit(_inner)
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = np.abs(x_ref[...])  # host numpy in a pallas kernel
+
+
+def shard_builder(mesh):
+    return shard_map(_kernel, mesh=mesh)

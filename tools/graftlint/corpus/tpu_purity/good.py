@@ -1,0 +1,24 @@
+"""tpu-purity good corpus: the same shapes done correctly."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def pure(x):
+    return jnp.sum(x)
+
+
+@partial(jax.jit, static_argnames=("op",))
+def branch_on_static(x, op):
+    if op == "neg":  # static arg: concrete at trace time
+        return -x
+    return jnp.where(x > 0, x, -x)
+
+
+def host_helper(x):
+    # NOT traced: host numpy is fine here
+    return int(np.sum(x))

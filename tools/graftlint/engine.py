@@ -1,0 +1,196 @@
+"""graftlint core: finding model, suppression parsing, orchestration.
+
+A *pass* is a module under tools/graftlint/passes exposing:
+
+    PASS_ID: str               stable kebab-case id (used in disable=...)
+    DESCRIPTION: str           one line for --list-passes
+    def applies(path) -> bool  path scope (repo-relative, '/'-separated)
+    def check(path, tree, lines) -> list[Finding]
+
+Project-wide passes (cross-file consistency) instead expose:
+
+    PROJECT = True
+    def check_project(files: dict[str, tuple[ast.AST, list[str]]]) -> list[Finding]
+
+Suppression comments (reason MANDATORY after ``--``)::
+
+    # graftlint: disable=<pass>[,<pass>] -- <reason>        (this line only)
+    # graftlint: disable-file=<pass>[,<pass>] -- <reason>   (whole file)
+
+A disable without a reason is reported as a ``bad-suppression`` finding
+that cannot itself be suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    pass_id: str
+    message: str
+    suppressed: bool = False
+    reason: str | None = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tail = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"[{self.pass_id}] {self.message}{tail}"
+        )
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<kind>disable(?:-file)?)\s*=\s*"
+    r"(?P<passes>[A-Za-z0-9_,-]+)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$"
+)
+
+
+def _comments(src: str):
+    """(line, col, text) of every real COMMENT token — docstrings or
+    string literals that merely *mention* the syntax must not count."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+class Suppressions:
+    """Parsed disable comments of one file."""
+
+    def __init__(self, path: str, lines: list[str]):
+        self.path = path
+        # (line, pass_id) -> reason / pass_id -> reason
+        self.by_line: dict[tuple[int, str], str] = {}
+        self.by_file: dict[str, str] = {}
+        self.errors: list[Finding] = []
+        for lineno, col, text in _comments("\n".join(lines)):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                # catch malformed graftlint comments so a typo'd disable
+                # doesn't silently do nothing
+                if re.match(r"#\s*graftlint\b", text):
+                    self.errors.append(
+                        Finding(
+                            path, lineno, col, "bad-suppression",
+                            "unparseable graftlint comment (expected "
+                            "'# graftlint: disable=<pass> -- <reason>')",
+                        )
+                    )
+                continue
+            passes = [p for p in m.group("passes").split(",") if p]
+            reason = m.group("reason")
+            if not reason:
+                self.errors.append(
+                    Finding(
+                        path, lineno, col, "bad-suppression",
+                        f"disable={m.group('passes')} has no reason; append "
+                        "' -- <why this is safe>'",
+                    )
+                )
+                continue
+            for p in passes:
+                if m.group("kind") == "disable-file":
+                    self.by_file[p] = reason
+                else:
+                    self.by_line[(lineno, p)] = reason
+
+    def match(self, f: Finding) -> str | None:
+        r = self.by_line.get((f.line, f.pass_id))
+        if r is not None:
+            return r
+        return self.by_file.get(f.pass_id)
+
+
+# Directories never worth descending into.  The bundled corpus is
+# deliberately full of violations, so the walker skips it even when the
+# caller lints the tools tree itself.
+_SKIP_DIRS = {"__pycache__", ".git", ".github", "corpus"}
+
+
+def walk_files(roots: list[str]) -> list[str]:
+    out: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def parse_file(path: str) -> tuple[ast.AST | None, list[str], Finding | None]:
+    """(tree, lines, parse_error_finding)."""
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    try:
+        return ast.parse(src, filename=path), lines, None
+    except SyntaxError as e:
+        return None, lines, Finding(
+            path, e.lineno or 1, e.offset or 0, "parse",
+            f"syntax error: {e.msg}",
+        )
+
+
+def load_passes():
+    from tools.graftlint.passes import ALL_PASSES
+
+    return ALL_PASSES
+
+
+def run(roots: list[str], passes=None) -> list[Finding]:
+    """Lint ``roots``; returns every finding, suppressed ones marked."""
+    if passes is None:
+        passes = load_passes()
+    file_passes = [p for p in passes if not getattr(p, "PROJECT", False)]
+    project_passes = [p for p in passes if getattr(p, "PROJECT", False)]
+
+    findings: list[Finding] = []
+    parsed: dict[str, tuple[ast.AST, list[str]]] = {}
+    supp: dict[str, Suppressions] = {}
+    for path in walk_files(roots):
+        tree, lines, err = parse_file(path)
+        supp[path] = Suppressions(path, lines)
+        findings.extend(supp[path].errors)
+        if err is not None:
+            findings.append(err)
+            continue
+        parsed[path] = (tree, lines)
+        rel = path.replace(os.sep, "/")
+        for p in file_passes:
+            if p.applies(rel):
+                findings.extend(p.check(path, tree, lines))
+    for p in project_passes:
+        findings.extend(p.check_project(parsed))
+
+    for f in findings:
+        if f.pass_id == "bad-suppression":
+            continue  # meta-findings are never suppressable
+        s = supp.get(f.path)
+        reason = s.match(f) if s is not None else None
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+    return findings

@@ -1,0 +1,22 @@
+"""Pass registry.  Adding a pass = writing the module + listing it here
+(docs/graftlint.md walks through it)."""
+
+from tools.graftlint.passes import (
+    dispatch_parity,
+    dtype_discipline,
+    durability,
+    exception_hygiene,
+    lock_discipline,
+    tpu_purity,
+)
+
+ALL_PASSES = [
+    tpu_purity,
+    dtype_discipline,
+    lock_discipline,
+    durability,
+    exception_hygiene,
+    dispatch_parity,
+]
+
+BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

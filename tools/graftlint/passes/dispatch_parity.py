@@ -1,0 +1,160 @@
+"""dispatch-parity: parser/executor and route/client surfaces must agree.
+
+Two cross-file invariants the round-5 review kept re-checking by hand:
+
+* every special call form the PQL parser recognizes (the ``specials``
+  dict in pql/parser.py) must have a handler in exec/executor.py's
+  name dispatch — a parseable-but-unexecutable call is a guaranteed
+  runtime "unknown call" for a query the grammar advertises;
+* every ``/internal/*`` route the HTTP server mounts (the ``_ROUTES``
+  table in server/http.py) must have a matching InternalClient method
+  in cluster/client.py — an uncallable internal endpoint is dead
+  surface, and an unserved client path is a cluster-wide 404 at the
+  worst possible time (resize, anti-entropy).
+
+This is a project-wide pass: it locates the four role files by their
+path suffixes under the linted roots, so it works unchanged on the
+bundled corpus mini-trees.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted, string_prefix
+from tools.graftlint.engine import Finding
+
+PASS_ID = "dispatch-parity"
+DESCRIPTION = "PQL specials vs executor dispatch; /internal routes vs client"
+PROJECT = True
+
+_PARSER_SUFFIX = "pql/parser.py"
+_EXECUTOR_SUFFIX = "exec/executor.py"
+_HTTP_SUFFIX = "server/http.py"
+_CLIENT_SUFFIX = "cluster/client.py"
+
+
+def applies(path: str) -> bool:  # unused for project passes; kept uniform
+    return False
+
+
+def _find(files: dict, suffix: str):
+    for path, (tree, lines) in files.items():
+        if path.replace("\\", "/").endswith(suffix):
+            return path, tree
+    return None, None
+
+
+# -- part A: parser specials vs executor dispatch ---------------------------
+
+
+def _parser_specials(tree: ast.AST) -> dict[str, int]:
+    """{call name: line} from the dict literal assigned to ``specials``."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "specials" for t in node.targets
+        ):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+def _executor_handled(tree: ast.AST) -> set[str]:
+    """String constants the executor compares a call name against:
+    ``name == "X"`` / ``call.name == "X"`` / ``name in ("X", "Y")``."""
+    handled: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        left = dotted(node.left)
+        if left is None or not (left == "name" or left.endswith(".name")):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(
+                comp, ast.Constant
+            ) and isinstance(comp.value, str):
+                handled.add(comp.value)
+            elif isinstance(op, (ast.In, ast.NotIn)) and isinstance(
+                comp, (ast.Tuple, ast.List, ast.Set)
+            ):
+                for el in comp.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        handled.add(el.value)
+    return handled
+
+
+# -- part B: /internal routes vs InternalClient paths -----------------------
+
+
+def _internal_routes(tree: ast.AST) -> dict[str, int]:
+    """{path: line} for ``^/internal/...$`` patterns in the _ROUTES table."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Constant) or not isinstance(node.value, str):
+            continue
+        pat = node.value
+        if not pat.startswith("^/internal/"):
+            continue
+        path = pat.lstrip("^").rstrip("$")
+        # parameterized segments can't be matched textually; compare the
+        # literal prefix only
+        for cut in ("(", "\\"):
+            if cut in path:
+                path = path[: path.index(cut)]
+        out[path.rstrip("/")] = node.lineno
+    return out
+
+
+def _client_paths(tree: ast.AST) -> set[str]:
+    """Literal /internal/... path prefixes the client requests."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        prefix = string_prefix(node)
+        if prefix is None or not prefix.startswith("/internal/"):
+            continue
+        path = prefix.split("?", 1)[0].split("{", 1)[0]
+        out.add(path.rstrip("/"))
+    return out
+
+
+def check_project(files: dict) -> list[Finding]:
+    findings: list[Finding] = []
+
+    parser_path, parser_tree = _find(files, _PARSER_SUFFIX)
+    _, executor_tree = _find(files, _EXECUTOR_SUFFIX)
+    if parser_tree is not None and executor_tree is not None:
+        handled = _executor_handled(executor_tree)
+        for name, line in sorted(_parser_specials(parser_tree).items()):
+            if name not in handled:
+                findings.append(
+                    Finding(
+                        parser_path, line, 0, PASS_ID,
+                        f"parser special {name!r} has no handler in the "
+                        "executor dispatch: parseable but unexecutable",
+                    )
+                )
+
+    http_path, http_tree = _find(files, _HTTP_SUFFIX)
+    _, client_tree = _find(files, _CLIENT_SUFFIX)
+    if http_tree is not None and client_tree is not None:
+        client = _client_paths(client_tree)
+        for route, line in sorted(_internal_routes(http_tree).items()):
+            covered = any(
+                c == route or c.startswith(route + "/") or route.startswith(c)
+                for c in client
+            )
+            if not covered:
+                findings.append(
+                    Finding(
+                        http_path, line, 0, PASS_ID,
+                        f"internal route {route!r} has no cluster/client.py "
+                        "method: dead endpoint or an unreachable peer call",
+                    )
+                )
+    return findings

@@ -1,0 +1,98 @@
+"""dtype-discipline: no 64-bit integers in the jnp world.
+
+Invariant: this codebase runs with ``jax_enable_x64`` OFF (the default),
+so every ``jnp`` integer array is at most 32 bits.  ``jnp.int64`` /
+``jnp.uint64`` silently alias their 32-bit cousins, and an integer
+literal wider than 32 bits flowing into a ``jnp`` constructor truncates
+without warning — positions are ``row*2^20 + col`` uint64 values on the
+host, so one careless hand-off corrupts data instead of erroring.  Wide
+integers must stay in host numpy (uint64 end to end) and cross to the
+device only after an explicit width reduction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "dtype-discipline"
+DESCRIPTION = "no int64/uint64 dtypes or >32-bit literals in jnp calls"
+
+_BAD_DTYPE_DOTTED = {
+    "jnp.int64", "jnp.uint64",
+    "jax.numpy.int64", "jax.numpy.uint64",
+    "np.int64", "np.uint64", "numpy.int64", "numpy.uint64",
+}
+_BAD_DTYPE_STRS = {"int64", "uint64"}
+_JNP_ROOTS = ("jnp.", "jax.numpy.")
+
+_INT32_MIN = -(2**31)
+_UINT32_MAX = 2**32 - 1
+
+
+def applies(path: str) -> bool:
+    return True
+
+
+def _is_jnp_call(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d is not None and d.startswith(_JNP_ROOTS)
+
+
+def _bad_dtype_expr(node: ast.AST) -> str | None:
+    d = dotted(node)
+    if d in _BAD_DTYPE_DOTTED:
+        return d
+    if isinstance(node, ast.Constant) and node.value in _BAD_DTYPE_STRS:
+        return repr(node.value)
+    return None
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[int, int]] = set()
+
+    def flag(node: ast.AST, msg: str) -> None:
+        # nested jnp calls re-walk their arguments; report each site once
+        if (node.lineno, node.col_offset) in seen:
+            return
+        seen.add((node.lineno, node.col_offset))
+        findings.append(Finding(path, node.lineno, node.col_offset, PASS_ID, msg))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d in ("jnp.int64", "jnp.uint64", "jax.numpy.int64", "jax.numpy.uint64"):
+                flag(
+                    node,
+                    f"{d} with x64 disabled silently means the 32-bit dtype",
+                )
+        if not isinstance(node, ast.Call) or not _is_jnp_call(node):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                bad = _bad_dtype_expr(kw.value)
+                # jnp.int64-style dtypes are already caught by the
+                # attribute rule above
+                if bad is not None and not bad.startswith(("jnp.", "jax.numpy.")):
+                    flag(
+                        kw.value,
+                        f"dtype={bad} passed to {dotted(node.func)}: 64-bit "
+                        "ints truncate to 32 with x64 disabled",
+                    )
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, int)
+                    and not isinstance(sub.value, bool)
+                    and (sub.value > _UINT32_MAX or sub.value < _INT32_MIN)
+                ):
+                    flag(
+                        sub,
+                        f"integer literal {sub.value} (needs >32 bits) inside "
+                        f"{dotted(node.func)}(...): truncates with x64 disabled",
+                    )
+    return findings

@@ -1,0 +1,57 @@
+"""exception-hygiene: no silently swallowed broad exceptions.
+
+Invariant: background loops (snapshot workers, anti-entropy, membership,
+import pool) must never die silently, and equally must never swallow
+evidence.  A bare ``except:`` or ``except Exception:`` whose body is
+nothing but ``pass``/``continue`` hides real faults (including
+KeyboardInterrupt for the bare form) with no log line and no stats
+counter — the failure mode is "the cluster quietly stopped converging".
+Narrow handlers (``except OSError: pass``) are fine; broad handlers must
+log, count, re-raise, or otherwise DO something with the failure.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "exception-hygiene"
+DESCRIPTION = "no bare/broad except whose body is only pass/continue"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def applies(path: str) -> bool:
+    return True
+
+
+def _is_noop(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue)):
+        return True
+    # a lone docstring/ellipsis inside the handler
+    return isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            kind = "bare except:"
+        else:
+            d = dotted(node.type)
+            if d not in _BROAD:
+                continue
+            kind = f"except {d}:"
+        if all(_is_noop(s) for s in node.body):
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    f"{kind} swallows the failure with no log, counter, or "
+                    "re-raise; narrow the type or record the error",
+                )
+            )
+    return findings

@@ -1,0 +1,88 @@
+"""lock-discipline: no blocking I/O while holding a lock.
+
+Invariant: the cluster and server layers are threaded, and their locks
+guard in-memory state transitions that every request path contends on.
+An RPC, socket/file read-write, or sleep lexically inside a ``with
+<..lock..>:`` body turns one slow peer into a cluster-wide stall (and,
+because node A's RPC handler may need the same lock to answer node B, a
+distributed deadlock).  The reference runs ``go vet`` + ``-race``; this
+is the closest static analogue: blocking calls must move outside the
+critical section (copy state under the lock, do I/O after).
+
+Heuristics: a With context expression whose final name component
+contains ``lock`` marks a critical section; flagged calls are the
+InternalClient RPC surface, urllib/socket/subprocess entry points,
+``time.sleep``, and file/socket method names (.read/.write/.recv/...).
+Nested function bodies are skipped (they run later, not under the
+lock).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted, walk_no_nested_functions
+from tools.graftlint.engine import Finding
+
+PASS_ID = "lock-discipline"
+DESCRIPTION = "no blocking I/O (RPC, sockets, sleep) inside `with lock:` bodies"
+
+# the InternalClient node<->node RPC surface (cluster/client.py)
+_RPC_METHODS = {
+    "query_node", "import_bits", "import_roaring", "fragment_blocks",
+    "block_data", "attr_blocks", "attr_block_data", "retrieve_fragment",
+    "fragment_list", "resize_fetch", "send_message", "translate_keys",
+    "translate_ids", "translate_log", "translate_restore",
+}
+_BLOCKING_ATTRS = _RPC_METHODS | {
+    "read", "readline", "write", "recv", "send", "sendall", "connect",
+    "urlopen", "getresponse", "sleep", "wait",
+}
+_BLOCKING_DOTTED = {
+    "time.sleep", "subprocess.run", "subprocess.check_output",
+    "urllib.request.urlopen",
+}
+
+
+def applies(path: str) -> bool:
+    return "/cluster/" in path or "/server/" in path
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+    return d is not None and "lock" in d.split(".")[-1].lower()
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    flagged: set[int] = set()  # id() of already-reported Call nodes
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        lock_names = [
+            dotted(item.context_expr)
+            for item in node.items
+            if _is_lock_ctx(item.context_expr)
+        ]
+        if not lock_names:
+            continue
+        held = ", ".join(n for n in lock_names if n) or "lock"
+        for sub in walk_no_nested_functions(node.body):
+            if not isinstance(sub, ast.Call) or id(sub) in flagged:
+                continue
+            d = dotted(sub.func)
+            attr = sub.func.attr if isinstance(sub.func, ast.Attribute) else None
+            if d in _BLOCKING_DOTTED or (attr in _BLOCKING_ATTRS):
+                flagged.add(id(sub))
+                what = d or f".{attr}(...)"
+                findings.append(
+                    Finding(
+                        path, sub.lineno, sub.col_offset, PASS_ID,
+                        f"blocking call {what} while holding {held}: move "
+                        "the I/O outside the critical section",
+                    )
+                )
+    return findings

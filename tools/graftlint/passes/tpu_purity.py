@@ -1,0 +1,159 @@
+"""tpu-purity: no host escapes inside traced (jit/pjit/Pallas) functions.
+
+Invariant: a function that XLA traces must stay inside the traced world.
+Host numpy calls silently constant-fold at trace time (wrong results when
+the traced value varies), ``.item()`` / ``float()`` / ``int()`` coercions
+force a device sync (ConcretizationTypeError at best, a silent blocking
+transfer at worst), and Python ``if``/``while`` on a traced value raises
+TracerBoolConversionError only for the shapes that reach it in testing.
+
+A function counts as traced when it is
+
+* decorated with ``jax.jit`` / ``jit`` / ``pjit`` (directly or through
+  ``functools.partial(jax.jit, ...)``), or
+* passed by name to ``jax.jit(...)`` / ``pjit(...)`` / ``shard_map(...)``
+  / ``pl.pallas_call(...)`` anywhere in the same module (the builder
+  idiom used throughout ops/kernels.py).
+
+Parameters named in ``static_argnames`` are concrete at trace time and
+exempt from the branching rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "tpu-purity"
+DESCRIPTION = "no host numpy/.item()/int()/branching inside traced functions"
+
+_JIT_DOTTED = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_DOTTED = {"partial", "functools.partial"}
+# callables whose function-valued argument gets traced
+_WRAPPER_SUFFIXES = ("shard_map", "pallas_call", "vmap", "scan", "checkpoint")
+
+
+def applies(path: str) -> bool:
+    return "/ops/" in path or path.endswith("exec/astbatch.py")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    return dotted(node) in _JIT_DOTTED
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            names: set[str] = set()
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        names.add(el.value)
+            return names
+    return set()
+
+
+def _traced_functions(tree: ast.AST) -> dict[ast.FunctionDef, set[str]]:
+    """Traced FunctionDefs -> their static (concrete) parameter names."""
+    # names passed to jax.jit(fn)/shard_map(fn)/pallas_call(kernel) calls
+    wrapped: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn_dotted = dotted(node.func)
+        is_wrapper = _is_jit_expr(node.func) or (
+            fn_dotted is not None and fn_dotted.endswith(_WRAPPER_SUFFIXES)
+        )
+        if not is_wrapper or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            wrapped.setdefault(target.id, set()).update(_static_argnames(node))
+
+    out: dict[ast.FunctionDef, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        static: set[str] | None = None
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                static = set()
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func):
+                    static = _static_argnames(dec)
+                elif dotted(dec.func) in _PARTIAL_DOTTED and dec.args and _is_jit_expr(
+                    dec.args[0]
+                ):
+                    static = _static_argnames(dec)
+        if static is None and node.name in wrapped:
+            static = wrapped[node.name]
+        if static is not None:
+            out[node] = static
+    return out
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[int, int, str]] = set()
+
+    def flag(node: ast.AST, msg: str) -> None:
+        # dedup: nested Attribute chains and functions traced through
+        # both a decorator and a wrapper call would double-report
+        key = (node.lineno, node.col_offset, msg.split(" inside ")[0])
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(path, node.lineno, node.col_offset, PASS_ID, msg))
+
+    for fn, static in _traced_functions(tree).items():
+        params = {
+            a.arg
+            for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+        } - static - {"self"}
+        for node in ast.walk(fn):
+            d = dotted(node) if isinstance(node, ast.Attribute) else None
+            if d is not None and (d.startswith("np.") or d.startswith("numpy.")):
+                flag(
+                    node,
+                    f"host numpy ({d}) inside traced function "
+                    f"{fn.name!r}: constant-folds at trace time",
+                )
+            if isinstance(node, ast.Call):
+                cd = dotted(node.func)
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                    flag(
+                        node,
+                        f".item() inside traced function {fn.name!r}: "
+                        "forces a device sync / concretization error",
+                    )
+                elif cd in ("float", "int", "bool") and node.args and not all(
+                    isinstance(a, ast.Constant) for a in node.args
+                ):
+                    flag(
+                        node,
+                        f"{cd}() coercion inside traced function {fn.name!r}: "
+                        "concretizes a traced value",
+                    )
+            if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                test = node.test
+                used = {
+                    n.id
+                    for n in ast.walk(test)
+                    if isinstance(n, ast.Name)
+                } & params
+                if used:
+                    kind = type(node).__name__
+                    flag(
+                        node,
+                        f"Python {kind} on possibly-traced parameter(s) "
+                        f"{sorted(used)} inside traced function {fn.name!r}: "
+                        "use lax.cond/jnp.where, or mark the arg static",
+                    )
+    return findings
