@@ -43,13 +43,58 @@ import numpy as np
 
 # Accelerator probe: a dead TPU tunnel makes jax.devices() hang forever,
 # which must not hang the benchmark.  Tunnel outages have been transient,
-# so retry hard before surrendering to CPU: 5 attempts with exponential
-# backoff (~19 min worst case).  Each attempt is a subprocess (init can
-# wedge the interpreter) whose stderr goes to a temp FILE — a killed
-# child can leave grandchildren holding inherited pipe ends, which would
-# block .run() past its timeout waiting for EOF.
+# so retry hard before surrendering to CPU: 6 attempts with exponential
+# backoff (~25 min worst case).  Each attempt is a subprocess (init can
+# wedge the interpreter) in its OWN SESSION, supervised by an in-process
+# watchdog that SIGKILLs the whole process group on timeout — a plain
+# subprocess timeout kills only the direct child, and a wedged TPU init
+# spawns grandchildren that keep holding the tunnel (and inherited pipe
+# ends) after the parent dies.  stderr goes to a temp FILE for the same
+# reason: a pipe would block past the timeout waiting for EOF.
 _PROBE_ATTEMPTS = []
-_PROBE_BACKOFFS = (0, 15, 30, 60, 120)
+_PROBE_BACKOFFS = (0, 15, 30, 60, 120, 240)
+_PROBE_TIMEOUT = 180
+
+
+def _probe_once(errf) -> int | str:
+    """One probe subprocess under a kill-the-whole-group watchdog;
+    returns the exit code, or a string describing the abort."""
+    import signal
+    import threading
+
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            # init AND do one tiny computation: device listing
+            # can succeed while the compile path is wedged
+            "import jax, jax.numpy as jnp;"
+            "import numpy as np;"
+            "np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=errf,
+        start_new_session=True,  # own process group: killpg reaps grandchildren
+    )
+    timed_out = threading.Event()
+
+    def _abort():
+        timed_out.set()
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    watchdog = threading.Timer(_PROBE_TIMEOUT, _abort)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        rc = proc.wait()
+    finally:
+        watchdog.cancel()
+    if timed_out.is_set():
+        return f"watchdog-killed after {_PROBE_TIMEOUT}s"
+    return rc
 
 
 def _accelerator_alive() -> bool:
@@ -60,35 +105,22 @@ def _accelerator_alive() -> bool:
         rec = {"attempt": attempt + 1, "backoff_s": backoff}
         with tempfile.TemporaryFile() as errf:
             try:
-                r = subprocess.run(
-                    [
-                        sys.executable,
-                        "-c",
-                        # init AND do one tiny computation: device listing
-                        # can succeed while the compile path is wedged
-                        "import jax, jax.numpy as jnp;"
-                        "import numpy as np;"
-                        "np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))",
-                    ],
-                    timeout=180,
-                    stdout=subprocess.DEVNULL,
-                    stderr=errf,
-                )
-                rec["rc"] = r.returncode
-            except subprocess.SubprocessError as e:
-                rec["rc"] = f"timeout/{type(e).__name__}"
+                rec["rc"] = _probe_once(errf)
+            except OSError as e:
+                rec["rc"] = f"spawn-failed/{type(e).__name__}"
             errf.seek(0, os.SEEK_END)
             sz = errf.tell()
             errf.seek(max(0, sz - 400))
             rec["stderr_tail"] = errf.read().decode("utf-8", "replace")[-400:]
         rec["secs"] = round(time.time() - t0, 1)
         _PROBE_ATTEMPTS.append(rec)
-        if rec["rc"] == 0:
-            return True
         print(
-            f"warning: accelerator probe attempt {attempt + 1} failed",
+            f"accelerator probe attempt {attempt + 1}/{len(_PROBE_BACKOFFS)}: "
+            f"rc={rec['rc']} after {rec['secs']}s (backoff {backoff}s)",
             file=sys.stderr,
         )
+        if rec["rc"] == 0:
+            return True
     return False
 
 

@@ -210,7 +210,7 @@ def _http(args, method: str, path: str, body: bytes | None = None, content_type=
     url = f"http://{args.host}{path}"
     req = urllib.request.Request(url, data=body, method=method)
     req.add_header("Content-Type", content_type)
-    with urllib.request.urlopen(req) as resp:
+    with urllib.request.urlopen(req, timeout=30) as resp:
         return resp.read()
 
 

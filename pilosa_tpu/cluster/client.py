@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -19,13 +21,110 @@ from typing import Any
 
 import numpy as np
 
+from pilosa_tpu import deadline
+from pilosa_tpu.deadline import DeadlineExceeded
 from pilosa_tpu.obs import tracing
+from pilosa_tpu.obs.stats import NOP
+from pilosa_tpu.testing import faults
 
 
 class ClientError(Exception):
     def __init__(self, msg: str, code: int = 0):
         super().__init__(msg)
         self.code = code
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Per-peer transport-failure breaker (closed -> open after
+    ``threshold`` consecutive transport failures -> half-open probe
+    after ``cooldown`` -> closed on success / open on failure).
+
+    Purely ADVISORY: the client never refuses a request because of a
+    tripped breaker — routing layers (``dist._group_by_live_owner``)
+    consult :meth:`allow` to steer fan-outs around a flapping peer
+    BEFORE the membership monitor confirms it down, and recovery flows
+    through the half-open probe that routing sends.  HTTP status errors
+    do not count (the peer's transport is alive); only connect/send/
+    receive failures and timeouts do.
+
+    State transitions are counted on the stats client
+    (``circuit_breaker_transitions{peer:..,to:..}``) so breaker churn is
+    observable at /metrics and /debug/vars.
+    """
+
+    def __init__(
+        self,
+        peer: str,
+        threshold: int = 5,
+        cooldown: float = 2.0,
+        stats=NOP,
+    ):
+        self.peer = peer
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self.stats = stats
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        """Move to ``to`` (lock held) and count the edge."""
+        self._state = to
+        self.stats.count_with_tags(
+            "circuit_breaker_transitions", 1, 1.0,
+            (f"peer:{self.peer}", f"to:{to}"),
+        )
+
+    def allow(self) -> bool:
+        """May a NEW request be routed at this peer right now?  In the
+        open state, the first call after the cooldown converts to a
+        half-open probe slot (exactly one in flight)."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                if time.monotonic() - self._opened_at >= self.cooldown:
+                    self._transition(BREAKER_HALF_OPEN)
+                    self._probing = True
+                    return True
+                return False
+            # half-open: one probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != BREAKER_CLOSED:
+                self._transition(BREAKER_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._state == BREAKER_HALF_OPEN or (
+                self._state == BREAKER_CLOSED
+                and self._failures >= self.threshold
+            ):
+                self._opened_at = time.monotonic()
+                self._transition(BREAKER_OPEN)
 
 
 class _ConnPool:
@@ -89,16 +188,25 @@ class _ConnPool:
         body: bytes | None,
         headers: dict,
         idempotent: bool = True,
+        timeout: float | None = None,
     ) -> tuple[int, bytes, str]:
         """(status, body, content-type); raises OSError-family on
         transport failure after one retry on a stale pooled
         connection.  ``idempotent=False`` restricts that retry to
         failures during the SEND phase: once the request has been
         handed to the kernel, the server may have executed it, and
-        replaying a non-idempotent request could double-apply it."""
+        replaying a non-idempotent request could double-apply it.
+
+        ``timeout`` overrides the pool default for THIS request — the
+        deadline-aware client derives it from the remaining budget so a
+        request with 0.3s left doesn't block 30s on a stalled peer."""
         parts = urllib.parse.urlsplit(url)
         key = (parts.scheme, parts.netloc)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
+        t = self._timeout if timeout is None else timeout
+        injected = faults.network_fault(parts.netloc, parts.path, t)
+        if injected is not None:
+            return injected
         # a pooled connection may have been closed by the server's
         # keep-alive timeout: retry ONCE on a fresh connection, but only
         # when the stale candidate came from the pool
@@ -109,6 +217,9 @@ class _ConnPool:
             fresh = conn is None
             if fresh:
                 conn = self._new_conn(parts.scheme, parts.netloc)
+            conn.timeout = t
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
             sent = False
             try:
                 conn.request(method, path, body=body, headers=headers)
@@ -138,8 +249,31 @@ class InternalClient:
         timeout: float = 30.0,
         skip_verify: bool = False,
         ca_cert: str | None = None,
+        stats=None,
+        retry_budget: int = 2,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 1.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 2.0,
+        rng_seed: int | None = None,
     ):
         self.timeout = timeout
+        self.stats = NOP if stats is None else stats
+        # Retry budget: transport failures retry with full-jitter
+        # exponential backoff, at most ``retry_budget`` extra attempts
+        # per request, never past the remaining deadline, and only for
+        # idempotent requests (reference retries imports once,
+        # http/client.go; we generalise with a bounded budget).
+        self.retry_budget = max(0, int(retry_budget))
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        # Seeded so chaos tests replay the same jitter sequence.
+        self._rng = random.Random(rng_seed)
+        self._rng_lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
         # TLS: a None context means urlopen verifies with the default
         # verifying context; ``ca_cert`` pins a private CA for
         # intra-cluster certs, and verification is only skipped when the
@@ -157,6 +291,35 @@ class InternalClient:
             self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
         self._pool = _ConnPool(timeout, self._ssl_ctx)
 
+    # -- circuit breakers ---------------------------------------------------
+
+    def _breaker(self, netloc: str) -> CircuitBreaker:
+        with self._breakers_lock:
+            br = self._breakers.get(netloc)
+            if br is None:
+                br = CircuitBreaker(
+                    netloc,
+                    threshold=self.breaker_threshold,
+                    cooldown=self.breaker_cooldown,
+                    stats=self.stats,
+                )
+                self._breakers[netloc] = br
+            return br
+
+    def peer_available(self, uri: str) -> bool:
+        """Advisory routing check: False while ``uri``'s breaker is open
+        (and not yet due for a half-open probe).  ``dist`` consults this
+        to steer fan-outs toward surviving replicas; it never blocks a
+        request that routing decides to send anyway."""
+        netloc = urllib.parse.urlsplit(uri).netloc
+        return self._breaker(netloc).allow()
+
+    def _backoff(self, attempt: int) -> float:
+        """Full-jitter exponential backoff for retry ``attempt`` (1-based)."""
+        ceiling = min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1)))
+        with self._rng_lock:
+            return self._rng.random() * ceiling
+
     # -- plumbing -----------------------------------------------------------
 
     def _do_full(
@@ -168,6 +331,7 @@ class InternalClient:
         content_type: str = "application/json",
         accept: str | None = None,
         idempotent: bool = True,
+        retries: int | None = None,
     ) -> tuple[bytes, str]:
         """(body, response content-type).
 
@@ -176,7 +340,10 @@ class InternalClient:
         ops are create-if-absent, translate appends are keyed by name,
         resize ops are target-state): replaying any of them is safe.  A
         FUTURE endpoint with execute-once semantics must pass False so
-        the pool won't replay it after a stale-connection failure."""
+        the pool won't replay it after a stale-connection failure.
+
+        ``retries`` overrides the client retry budget for this call
+        (liveness probes pass 0 so a down-check stays prompt)."""
         headers: dict = {}
         if body is not None:
             headers["Content-Type"] = content_type
@@ -187,20 +354,58 @@ class InternalClient:
         span = tracing.active_span()
         if span is not None:
             tracing.get_tracer().inject_headers(span.context, headers)
-        try:
-            status, data, ctype = self._pool.request(
-                method,
-                uri.rstrip("/") + path,
-                body,
-                headers,
-                idempotent=idempotent,
-            )
-        except (http.client.HTTPException, OSError, TimeoutError) as e:
-            raise ClientError(f"{method} {path}: {e}") from e
-        if status >= 400:
-            detail = data.decode(errors="replace")[:500]
-            raise ClientError(f"{method} {path}: {status} {detail}", status)
-        return data, ctype
+        netloc = urllib.parse.urlsplit(uri).netloc
+        breaker = self._breaker(netloc)
+        budget = self.retry_budget if retries is None else max(0, int(retries))
+        if not idempotent:
+            budget = 0  # backoff retries would replay a received request
+        attempt = 0
+        while True:
+            # Per-hop timeout from the remaining deadline budget: fail
+            # fast when it is already spent, and never let the socket
+            # outlive what the caller is willing to wait.
+            rem = deadline.remaining()
+            if rem is not None:
+                if rem <= 0:
+                    self.stats.count("client_deadline_exceeded", 1, 1.0)
+                    raise DeadlineExceeded(
+                        f"deadline exceeded before {method} {path} to {netloc}"
+                    )
+                headers[deadline.HEADER] = format(rem, ".4f")
+                hop_timeout = min(self.timeout, rem)
+            else:
+                hop_timeout = self.timeout
+            try:
+                status, data, ctype = self._pool.request(
+                    method,
+                    uri.rstrip("/") + path,
+                    body,
+                    headers,
+                    idempotent=idempotent,
+                    timeout=hop_timeout,
+                )
+            except (http.client.HTTPException, OSError, TimeoutError) as e:
+                breaker.record_failure()
+                if attempt >= budget:
+                    raise ClientError(f"{method} {path}: {e}") from e
+                attempt += 1
+                delay = self._backoff(attempt)
+                rem = deadline.remaining()
+                if rem is not None and rem <= delay:
+                    # no budget left to wait out the backoff
+                    self.stats.count("client_deadline_exceeded", 1, 1.0)
+                    raise DeadlineExceeded(
+                        f"deadline exceeded retrying {method} {path} to "
+                        f"{netloc}: {e}"
+                    ) from e
+                self.stats.count("client_retries", 1, 1.0)
+                time.sleep(delay)
+                continue
+            breaker.record_success()
+            if status >= 400:
+                detail = data.decode(errors="replace")[:500]
+                raise ClientError(f"{method} {path}: {status} {detail}", status)
+            return data, ctype
 
     def _do(
         self,
@@ -367,8 +572,11 @@ class InternalClient:
 
     def version(self, uri: str) -> dict:
         """Liveness double-check (reference confirmNodeDown
-        cluster.go:1699-1726 probes /version)."""
-        return self._json("GET", uri, "/version")
+        cluster.go:1699-1726 probes /version).  ``retries=0``: a probe
+        that backs off just delays the down-confirmation it exists to
+        speed up — MembershipMonitor owns the retry cadence."""
+        out, _ = self._do_full("GET", uri, "/version", retries=0)
+        return json.loads(out) if out else None
 
     def shards_max(self, uri: str) -> dict:
         """Per-index max shard seen by ``uri`` (reference

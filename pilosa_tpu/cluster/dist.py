@@ -25,7 +25,7 @@ import contextvars
 import threading
 from typing import Any, Callable
 
-from pilosa_tpu import pql
+from pilosa_tpu import deadline, pql
 from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.cluster.cluster import Cluster
 from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
@@ -321,6 +321,10 @@ class DistributedExecutor:
             partials: list[Any] = []
             pending = list(shards)
             while pending:
+                # Fail the whole fan-out fast once the request's budget
+                # is spent — re-mapping shards onto replicas is pointless
+                # work the caller will never see.
+                deadline.check(f"mapping {call.name} over {index_name}")
                 groups = self._group_by_live_owner(index_name, pending, bad_nodes)
                 pending = []
                 # Remote nodes are queried CONCURRENTLY (one pool task per
@@ -357,17 +361,40 @@ class DistributedExecutor:
                 partials = [self.local._execute_call(idx, call, [])]
             return partials
 
+    def _peer_available(self, node) -> bool:
+        """Circuit-breaker routing check — local node is always
+        available (no transport involved), and a client without breakers
+        (NopInternalClient, test doubles) never vetoes a peer."""
+        if node.id == self.cluster.node_id:
+            return True
+        check = getattr(self.client, "peer_available", None)
+        if check is None:
+            return True
+        return check(node.uri)
+
     def _group_by_live_owner(
         self, index_name: str, shards: list[int], bad_nodes: set[str]
     ) -> dict[str, list[int]]:
         groups: dict[str, list[int]] = {}
         for s in shards:
             owner = None
+            fallback = None
             for node in self.cluster.shard_nodes(index_name, s):
                 if node.id in bad_nodes or node.state == NODE_STATE_DOWN:
                     continue
-                owner = node
-                break
+                # Two-pass selection: prefer a replica whose circuit
+                # breaker admits traffic, so fan-outs route around a
+                # flapping peer BEFORE membership confirms it down; if
+                # every live replica is tripped, degrade gracefully and
+                # use the first anyway (it may have just recovered, and
+                # failover still covers us if it hasn't).
+                if fallback is None:
+                    fallback = node
+                if self._peer_available(node):
+                    owner = node
+                    break
+            if owner is None:
+                owner = fallback
             if owner is None:
                 raise NoAvailableReplicaError(
                     f"no available replica for shard {s} of {index_name!r}"

@@ -27,7 +27,9 @@ monitor keeps the two parts that still matter on a multi-host cluster:
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import zlib
 
 from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.cluster import Cluster, STATE_RESIZING
@@ -59,6 +61,9 @@ class MembershipMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._rr = 0
+        # Per-node seed: every prober jitters its confirm cadence
+        # differently, but each node's sequence replays deterministically.
+        self._rng = random.Random(zlib.crc32(cluster.node_id.encode()))
 
     # -- probing ------------------------------------------------------------
 
@@ -96,13 +101,23 @@ class MembershipMonitor:
 
     def confirm_node_down(self, node) -> bool:
         """Double-check with retries before declaring a peer dead
-        (reference confirmNodeDown cluster.go:1699-1726). True = down."""
-        for _ in range(self.confirm_retries):
+        (reference confirmNodeDown cluster.go:1699-1726). True = down.
+
+        The inter-probe wait backs off exponentially (capped at 4x the
+        base interval) with jitter, so the cluster's probers don't hammer
+        a dying peer in lockstep — a peer that is merely restarting gets
+        quieter retries spread over the same overall confirmation window
+        order of magnitude."""
+        for attempt in range(self.confirm_retries):
             if self._stop.is_set():
                 return False  # shutting down: never declare peers dead
             if self._ping(node):
                 return False
-            if self._stop.wait(self.confirm_interval):
+            wait = min(
+                self.confirm_interval * (2 ** attempt),
+                4 * self.confirm_interval,
+            ) * (0.5 + self._rng.random())
+            if self._stop.wait(wait):
                 return False
         return True
 

@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from pilosa_tpu import pql
+from pilosa_tpu import deadline, pql
 from pilosa_tpu.core import membudget, timequantum
 from pilosa_tpu.obs import tracing
 from pilosa_tpu.core.field import (
@@ -1142,6 +1142,10 @@ class Executor:
 
     def _execute_call(self, idx: Index, call: Call, shards: list[int] | None) -> Any:
         name = call.name
+        # Stop before starting a shard scan the caller will never wait
+        # for — the deadline contextvar follows forwarded sub-queries
+        # here via the X-Pilosa-Deadline header (pilosa_tpu/deadline.py).
+        deadline.check(f"executing {name} on {idx.name!r}")
         # Per-call-type query counts (reference executor.go:298-339).
         self.holder.stats.count_with_tags(
             "query_total", 1, 1.0, (f"index:{idx.name}", f"call:{name}")

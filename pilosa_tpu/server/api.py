@@ -15,7 +15,7 @@ from typing import Any
 
 import numpy as np
 
-from pilosa_tpu import __version__
+from pilosa_tpu import __version__, deadline
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core import timequantum
@@ -163,6 +163,11 @@ class API:
         QueryRequest): keys arrive pre-translated, results return in wire
         encoding for the caller's reduce step."""
         self._validate("Query")
+        # Fail fast if the budget is already spent (e.g. a forwarded
+        # sub-query whose header arrived expired) — DeadlineExceeded is
+        # deliberately outside the ApiError catch below so it reaches
+        # the transport layer's 504 mapping.
+        deadline.check(f"query on {index!r}")
         from pilosa_tpu.pql import ParseError
 
         try:
@@ -320,6 +325,7 @@ class API:
         remaining slice to every replica owning its shard (api.go:964-995),
         marked ``remote`` so receivers do not re-forward."""
         self._validate("Import")
+        deadline.check(f"import into {index!r}/{field!r}")
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError("index not found")

@@ -33,6 +33,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu import deadline
+from pilosa_tpu.deadline import DeadlineExceeded
 from pilosa_tpu.obs import tracing
 from pilosa_tpu.server.api import API, ApiError
 
@@ -85,6 +87,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
 class Handler(BaseHTTPRequestHandler):
     api: API = None  # set by make_server
     long_query_time: float = 0.0
+    default_deadline: float = 0.0  # seconds; 0 = no default deadline
     protocol_version = "HTTP/1.1"
     # TCP_NODELAY on accepted sockets (socketserver applies this in
     # StreamRequestHandler.setup): with keep-alive connections (the
@@ -120,6 +123,21 @@ class Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise ApiError(f"invalid json: {e}")
 
+    def _request_budget(self) -> float | None:
+        """Deadline budget for this request, by precedence: explicit
+        ``timeout=`` query param (seconds) > ``X-Pilosa-Deadline`` header
+        (remaining budget forwarded by an upstream node) > the server's
+        configured default.  None/0 disables the deadline — malformed
+        values fall through rather than erroring, matching header
+        semantics (a bad deadline must not reject the request)."""
+        raw = self.query_params.get("timeout", [None])[0]
+        budget = deadline.from_header(raw)
+        if budget is None:
+            budget = deadline.from_header(self.headers.get(deadline.HEADER))
+        if budget is None and self.default_deadline > 0:
+            budget = self.default_deadline
+        return budget
+
     def _dispatch(self, method: str) -> None:
         if getattr(type(self), "paused", None) is not None and type(self).paused.is_set():
             # Fault injection: emulate a paused process (reference uses
@@ -145,8 +163,16 @@ class Handler(BaseHTTPRequestHandler):
                 span = tracing.start_span(f"http.{name}", child_of=parent)
                 span.set_tag("method", method).set_tag("path", parsed.path)
                 try:
-                    with span:
+                    with deadline.scope(self._request_budget()), span:
                         getattr(self, "r_" + name)(**match.groupdict())
+                except DeadlineExceeded as e:
+                    # Distinct from ApiError (400-family): a spent budget
+                    # is a timeout, not a client mistake (reference maps
+                    # context.DeadlineExceeded similarly).
+                    self.api.holder.stats.count(
+                        "http_deadline_exceeded", 1, 1.0
+                    )
+                    self._send_json(504, {"error": f"deadline exceeded: {e}"})
                 except ApiError as e:
                     self._send_json(e.code, {"error": str(e)})
                 except BrokenPipeError:
@@ -476,6 +502,7 @@ class Server:
         long_query_time: float = 0.0,
         tls_cert: str | None = None,
         tls_key: str | None = None,
+        default_deadline: float = 0.0,
     ):
         handler = type(
             "BoundHandler",
@@ -483,6 +510,7 @@ class Server:
             {
                 "api": api,
                 "long_query_time": long_query_time,
+                "default_deadline": default_deadline,
                 "paused": threading.Event(),
             },
         )

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import uuid
+import zlib
 
 from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster
@@ -46,6 +47,11 @@ class NodeServer:
         import_workers: int = 2,
         import_queue_depth: int = 16,
         max_writes_per_request: int | None = None,
+        default_deadline: float = 0.0,
+        client_timeout: float = 30.0,
+        client_retry_budget: int = 2,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 2.0,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -64,7 +70,15 @@ class NodeServer:
         node_id = self.store.node_id() if self.store else uuid.uuid4().hex
         self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
         self.client = InternalClient(
-            skip_verify=tls_skip_verify, ca_cert=tls_ca_cert
+            timeout=client_timeout,
+            skip_verify=tls_skip_verify,
+            ca_cert=tls_ca_cert,
+            stats=self.holder.stats,
+            retry_budget=client_retry_budget,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown=breaker_cooldown,
+            # Deterministic jitter per node (chaos tests rely on replay).
+            rng_seed=zlib.crc32(node_id.encode()),
         )
         self.broadcaster = HTTPBroadcaster(self.cluster, self.client, node_id)
         self.api = API(
@@ -95,6 +109,7 @@ class NodeServer:
             long_query_time=long_query_time,
             tls_cert=tls_cert,
             tls_key=tls_key,
+            default_deadline=default_deadline,
         )
         # Diagnostics + runtime metrics loops (reference server.go:433-436
         # monitorDiagnostics/monitorRuntime, gcnotify).

@@ -19,6 +19,7 @@ import numpy as np
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.storage import roaring
+from pilosa_tpu.testing import faults
 
 # reference fragment.go:84.
 MAX_OP_N = 10000
@@ -161,6 +162,10 @@ class FragmentFile:
         disk (it was the bottleneck of sustained ingest)."""
         if not records:
             return
+        # Fault-injection hook (testing/faults.py): raises OSError so a
+        # chaos test can see a failed op-log append surface through the
+        # import path the way a real ENOSPC would.
+        faults.disk_write_fault(self.path)
         with self._lock:
             if self._fh is None:
                 self._fh = open(self.path, "ab")
@@ -329,6 +334,7 @@ class FragmentFile:
 
     def _write_snapshot_file(self, data: bytes) -> None:
         """Swap in an encoded snapshot (both locks held)."""
+        faults.disk_write_fault(self.path)
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
             f.write(data)

@@ -1,7 +1,20 @@
 """In-process test harness (reference: test/ package, 1000 LoC —
 test.MustRunCluster boots n real nodes with real transport on port 0,
-test/pilosa.go:344-400)."""
+test/pilosa.go:344-400) plus the deterministic fault-injection registry
+(``pilosa_tpu.testing.faults``).
 
-from pilosa_tpu.testing.cluster import InProcessCluster
+``InProcessCluster`` is re-exported lazily: production modules
+(cluster/client.py, storage/fragmentfile.py) import
+``pilosa_tpu.testing.faults`` for their fault hook points, and an eager
+import here would cycle back through server/node.py into the client.
+"""
 
 __all__ = ["InProcessCluster"]
+
+
+def __getattr__(name):
+    if name == "InProcessCluster":
+        from pilosa_tpu.testing.cluster import InProcessCluster
+
+        return InProcessCluster
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -11,9 +11,11 @@ imports, and queries against every node.
 from __future__ import annotations
 
 import tempfile
+import urllib.parse
 
 from pilosa_tpu.server.node import NodeServer
 from pilosa_tpu.shardwidth import SHARD_WORDS
+from pilosa_tpu.testing import faults
 
 
 class InProcessCluster:
@@ -45,6 +47,7 @@ class InProcessCluster:
         self.coordinator_id = self.nodes[0].node_id
         for s in self.nodes:
             s.join_static(members, self.coordinator_id)
+        self._faults: faults.FaultRegistry | None = None
 
     def __enter__(self) -> "InProcessCluster":
         return self
@@ -131,6 +134,51 @@ class InProcessCluster:
                 total[k] = total.get(k, 0) + v
         return total
 
+    # -- deterministic fault injection (testing/faults.py) -------------------
+
+    def fault_registry(self, seed: int = 0) -> faults.FaultRegistry:
+        """The cluster's installed fault registry (created + installed
+        lazily; ``seed`` only applies to the first call)."""
+        if self._faults is None:
+            self._faults = faults.install(faults.FaultRegistry(seed=seed))
+        return self._faults
+
+    def inject_fault(
+        self,
+        kind: str,
+        node: int | None = None,
+        peer: str | None = None,
+        route: str | None = None,
+        path: str | None = None,
+        delay: float = 0.0,
+        code: int = 503,
+        times: int | None = None,
+        p: float = 1.0,
+        seed: int = 0,
+    ) -> faults.Fault:
+        """Add one fault rule; returns it for later ``remove``/``hits``
+        inspection.  ``node`` is an index into ``self.nodes`` and is
+        shorthand for ``peer=<that node's netloc>`` (network kinds) —
+        use ``peer``/``route``/``path`` fnmatch patterns for anything
+        finer.  Example::
+
+            cl.inject_fault("reset", node=1, route="/index/*", times=2)
+            cl.inject_fault("slow", node=2, delay=5.0)
+            cl.inject_fault("disk_write_fail", path="*/ci/cf/*")
+        """
+        if node is not None:
+            if peer is not None:
+                raise ValueError("pass node OR peer, not both")
+            peer = urllib.parse.urlsplit(self.nodes[node].uri).netloc
+        return self.fault_registry(seed=seed).add(
+            kind, peer=peer, route=route, path=path,
+            delay=delay, code=code, times=times, p=p,
+        )
+
+    def clear_faults(self) -> None:
+        if self._faults is not None:
+            self._faults.clear()
+
     def stop_node(self, i: int) -> None:
         """Hard-stop one node (fault injection — the reference uses pumba
         pause in internal/clustertests)."""
@@ -145,6 +193,9 @@ class InProcessCluster:
         self.nodes[i].server.resume()
 
     def close(self) -> None:
+        if self._faults is not None:
+            faults.uninstall(self._faults)
+            self._faults = None
         for s in self.nodes:
             try:
                 s.stop()

@@ -521,7 +521,7 @@ def test_remove_node_and_abort_over_http():
             data=_json.dumps(body or {}).encode(),
             method="POST",
         )
-        return _json.load(urllib.request.urlopen(req))
+        return _json.load(urllib.request.urlopen(req, timeout=10))
 
     with InProcessCluster(3, replica_n=2) as c:
         c.create_index("rn")

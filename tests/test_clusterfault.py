@@ -1,11 +1,20 @@
 """Cluster fault-injection: reads survive a paused node and data
 re-converges after resume (reference internal/clustertests/cluster_test.go
-:68-92, which pumba-pauses a node for 10s and asserts counts survive)."""
+:68-92, which pumba-pauses a node for 10s and asserts counts survive),
+plus deterministic chaos scenarios through testing/faults.py — injected
+resets fail over, slow replicas trip the request deadline (HTTP 504),
+circuit breakers recover through half-open, and injected disk write
+errors surface from the import path."""
 
+import json
 import time
+import urllib.error
+import urllib.parse
+import urllib.request
 
 import pytest
 
+from pilosa_tpu.testing import faults
 from pilosa_tpu.testing.cluster import InProcessCluster
 
 
@@ -81,3 +90,148 @@ def test_data_converges_after_pause_and_writes(cluster):
     counts = _counts_everywhere(cluster)
     assert len(set(counts)) == 1, counts
     assert counts[0] >= cluster.expected
+
+
+# -- deterministic fault injection (testing/faults.py) -----------------------
+
+
+@pytest.fixture()
+def chaos_cluster():
+    """Fresh per-test cluster: chaos scenarios mutate breaker and fault
+    state, which must not leak between tests."""
+    with InProcessCluster(3, replica_n=2) as c:
+        c.create_index("ci")
+        c.create_field("ci", "cf")
+        width = c.nodes[0].holder.n_words * 32
+        bits = [(1, i * 7 % (3 * width)) for i in range(200)]
+        c.import_bits("ci", "cf", bits)
+        c.expected = len({col for _, col in bits})
+        yield c
+
+
+def _remote_pair(cluster):
+    """(querying node index, victim node index) such that the victim is
+    the primary owner of shard 0 and the querier is a different node —
+    guarantees the query fans out over the victim regardless of how the
+    run's node ids hash."""
+    victim_id = cluster.owner_of("ci", 0).node_id
+    victim = next(
+        i for i, n in enumerate(cluster.nodes) if n.node_id == victim_id
+    )
+    querier = next(i for i in range(len(cluster.nodes)) if i != victim)
+    return querier, victim
+
+
+def test_injected_reset_fails_over_to_replica(chaos_cluster):
+    c = chaos_cluster
+    querier, victim = _remote_pair(c)
+    fault = c.inject_fault("reset", node=victim, route="/index/*")
+    got = c.query(querier, "ci", "Count(Row(cf=1))")["results"][0]
+    assert got == c.expected
+    assert fault.hits > 0, "fault never fired: query did not fan out"
+
+
+def test_slow_replica_hits_deadline_within_budget(chaos_cluster):
+    c = chaos_cluster
+    querier, victim = _remote_pair(c)
+    c.inject_fault("slow", node=victim, route="/index/*", delay=30.0)
+    budget = 0.4
+    url = f"{c.nodes[querier].uri}/index/ci/query?timeout={budget}"
+    req = urllib.request.Request(
+        url, data=b"Count(Row(cf=1))", method="POST"
+    )
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=10)
+    elapsed = time.monotonic() - t0
+    assert exc_info.value.code == 504
+    body = json.loads(exc_info.value.read())
+    assert "deadline exceeded" in body["error"]
+    # acceptance: expiry surfaces within deadline + 0.5s
+    assert elapsed < budget + 0.5, f"504 took {elapsed:.2f}s"
+
+
+def test_expired_forwarded_deadline_fails_fast(chaos_cluster):
+    """A sub-request arriving with an exhausted X-Pilosa-Deadline header
+    is rejected up front with 504 — no shard scan starts."""
+    from pilosa_tpu import deadline
+
+    c = chaos_cluster
+    url = f"{c.nodes[0].uri}/index/ci/query"
+    req = urllib.request.Request(
+        url, data=b"Count(Row(cf=1))", method="POST",
+        headers={deadline.HEADER: "0.0001"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc_info.value.code == 504
+
+
+def test_breaker_recovers_through_half_open(chaos_cluster):
+    """closed -> open after threshold transport failures -> half-open
+    probe after cooldown -> closed on success, with every transition
+    counted on the stats client."""
+    from pilosa_tpu.obs.stats import MemStatsClient
+
+    from pilosa_tpu.cluster.client import InternalClient
+
+    c = chaos_cluster
+    target = c.nodes[0].uri
+    netloc = urllib.parse.urlsplit(target).netloc
+    stats = MemStatsClient()
+    client = InternalClient(
+        timeout=2.0, stats=stats, retry_budget=0,
+        breaker_threshold=2, breaker_cooldown=0.1, rng_seed=0,
+    )
+    fault = c.inject_fault("reset", node=0, route="/version", times=2)
+    for _ in range(2):
+        with pytest.raises(Exception):
+            client.version(target)
+    assert fault.times == 0, "both injected resets should have fired"
+    assert not client.peer_available(target), "breaker should be open"
+    time.sleep(0.15)  # past the cooldown: next check is the half-open probe
+    assert client.peer_available(target)
+    client.version(target)  # probe succeeds (fault exhausted) -> closed
+    assert client.peer_available(target)
+    counters = stats.snapshot()["counters"]
+
+    def transitions(state):
+        return sum(
+            v for k, v in counters.items()
+            if k.startswith("circuit_breaker_transitions")
+            and f"to:{state}" in k and f"peer:{netloc}" in k
+        )
+
+    assert transitions("open") == 1
+    assert transitions("half-open") == 1
+    assert transitions("closed") == 1
+
+
+def test_injected_disk_write_error_surfaces_from_import():
+    with InProcessCluster(1, with_disk=True) as c:
+        c.create_index("di")
+        c.create_field("di", "df")
+        c.inject_fault("disk_write_fail", path="*/di/df/*")
+        with pytest.raises(OSError, match="fault-injected disk write"):
+            c.import_bits("di", "df", [(1, 1), (1, 2)])
+        c.clear_faults()
+        # with the fault cleared the same import lands
+        c.import_bits("di", "df", [(1, 1), (1, 2)])
+        assert c.query(0, "di", "Count(Row(df=1))")["results"][0] == 2
+
+
+def test_fault_registry_is_deterministic():
+    """Same seed -> identical firing pattern for probabilistic rules."""
+
+    def pattern(seed):
+        reg = faults.FaultRegistry(seed=seed)
+        reg.add("error", p=0.5, route="/x")
+        out = []
+        for _ in range(64):
+            out.append(reg.network_fault("peer:1", "/x", 1.0) is not None)
+        return out
+
+    a, b = pattern(seed=7), pattern(seed=7)
+    assert a == b
+    assert any(a) and not all(a), "p=0.5 should fire sometimes, not always"
+    assert pattern(seed=8) != a

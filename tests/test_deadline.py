@@ -1,0 +1,65 @@
+"""Unit tests for the deadline contextvar module (pilosa_tpu/deadline.py):
+scope/remaining/check semantics, header round-tripping, and propagation
+into copied contexts (the fan-out pool mechanism)."""
+
+import contextvars
+
+import pytest
+
+from pilosa_tpu import deadline
+from pilosa_tpu.deadline import DeadlineExceeded
+
+
+def test_no_deadline_by_default():
+    assert deadline.remaining() is None
+    assert not deadline.expired()
+    deadline.check()  # no-op without an active budget
+    assert deadline.header_value() is None
+
+
+def test_scope_sets_and_restores():
+    with deadline.scope(5.0):
+        r = deadline.remaining()
+        assert r is not None and 4.5 < r <= 5.0
+        assert not deadline.expired()
+    assert deadline.remaining() is None
+
+
+def test_zero_or_none_budget_is_noop():
+    with deadline.scope(None):
+        assert deadline.remaining() is None
+    with deadline.scope(0):
+        assert deadline.remaining() is None
+
+
+def test_expired_budget_raises():
+    with deadline.scope(1e-9):
+        assert deadline.expired()
+        with pytest.raises(DeadlineExceeded, match="deadline exceeded"):
+            deadline.check("unit test")
+
+
+def test_header_round_trip():
+    with deadline.scope(2.0):
+        value = deadline.header_value()
+        parsed = deadline.from_header(value)
+        assert parsed is not None and 1.5 < parsed <= 2.0
+
+
+@pytest.mark.parametrize("garbage", [None, "", "abc", "nan", "inf"])
+def test_malformed_header_is_ignored(garbage):
+    assert deadline.from_header(garbage) is None
+
+
+def test_negative_header_clamps_to_zero():
+    assert deadline.from_header("-3.5") == 0.0
+
+
+def test_deadline_follows_copied_context():
+    """dist._submit runs fan-out tasks under contextvars.copy_context();
+    the budget must be visible there and invisible outside."""
+    with deadline.scope(5.0):
+        ctx = contextvars.copy_context()
+    assert deadline.remaining() is None
+    r = ctx.run(deadline.remaining)
+    assert r is not None and r > 4.0

@@ -84,7 +84,9 @@ def test_http_diagnostics_route():
     try:
         node.api.create_index("i")
         snap = json.loads(
-            urllib.request.urlopen(node.uri + "/internal/diagnostics").read()
+            urllib.request.urlopen(
+                node.uri + "/internal/diagnostics", timeout=10
+            ).read()
         )
         assert snap["numIndexes"] == 1
         assert snap["numNodes"] == 1

@@ -21,6 +21,7 @@ PER_FILE = [
     "lock_discipline",
     "durability",
     "exception_hygiene",
+    "timeout_discipline",
 ]
 
 
@@ -80,6 +81,13 @@ class TestBadCorpusCoverage:
         msgs = " | ".join(self._msgs("exception_hygiene"))
         assert "bare except" in msgs
         assert "except Exception" in msgs
+
+    def test_timeout_classes(self):
+        msgs = " | ".join(self._msgs("timeout_discipline"))
+        assert "urlopen" in msgs
+        assert "HTTPConnection" in msgs
+        assert "HTTPSConnection" in msgs
+        assert "create_connection" in msgs
 
 
 class TestDispatchParity:

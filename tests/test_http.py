@@ -33,7 +33,7 @@ def call(srv, method, path, body=None, content_type="application/json", raw=Fals
     data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
     req = urllib.request.Request(url, data=data, method=method)
     req.add_header("Content-Type", content_type)
-    with urllib.request.urlopen(req) as resp:
+    with urllib.request.urlopen(req, timeout=10) as resp:
         payload = resp.read()
         return payload if raw else (json.loads(payload) if payload.strip() else {})
 

@@ -130,19 +130,19 @@ def test_http_metrics_and_debug_vars(tmp_path):
         req = urllib.request.Request(
             base + "/index/i/query", data=b"Set(5, f=1)", method="POST"
         )
-        urllib.request.urlopen(req).read()
+        urllib.request.urlopen(req, timeout=10).read()
         # request counters fire after the response bytes are sent, so a
         # fetch on another connection can race them — poll briefly
         text = ""
         for _ in range(100):
-            with urllib.request.urlopen(base + "/metrics") as r:
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
                 text = r.read().decode()
             if "pilosa_http_requests" in text:
                 break
             time.sleep(0.02)
         assert "pilosa_set_bit" in text
         assert "pilosa_http_requests" in text
-        with urllib.request.urlopen(base + "/debug/vars") as r:
+        with urllib.request.urlopen(base + "/debug/vars", timeout=10) as r:
             snap = json.loads(r.read())
         assert any(k.startswith("set_bit") for k in snap["counters"])
         # serving-cache counters ride along (the reference's cache
@@ -153,8 +153,8 @@ def test_http_metrics_and_debug_vars(tmp_path):
             req = urllib.request.Request(
                 base + "/index/i/query", data=q, method="POST"
             )
-            urllib.request.urlopen(req).read()
-        with urllib.request.urlopen(base + "/debug/vars") as r:
+            urllib.request.urlopen(req, timeout=10).read()
+        with urllib.request.urlopen(base + "/debug/vars", timeout=10) as r:
             snap = json.loads(r.read())
         assert snap["serving_cache"]["gram_hits"] >= 1
     finally:

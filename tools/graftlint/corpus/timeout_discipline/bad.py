@@ -1,0 +1,27 @@
+"""timeout-discipline bad corpus."""
+
+import http.client
+import socket
+import urllib.request
+from urllib.request import urlopen
+
+
+def fetch(url):
+    return urllib.request.urlopen(url).read()  # no timeout
+
+
+def fetch_bare(url):
+    with urlopen(url) as resp:  # no timeout
+        return resp.read()
+
+
+def connect(host):
+    return http.client.HTTPConnection(host)  # no timeout
+
+
+def connect_tls(host, ctx):
+    return http.client.HTTPSConnection(host, context=ctx)  # no timeout
+
+
+def raw(addr):
+    return socket.create_connection(addr)  # no timeout

@@ -1,0 +1,36 @@
+"""timeout-discipline good corpus."""
+
+import http.client
+import socket
+import urllib.request
+from urllib.request import urlopen
+
+
+def fetch(url):
+    return urllib.request.urlopen(url, timeout=10).read()
+
+
+def fetch_positional(url):
+    return urllib.request.urlopen(url, None, 10).read()
+
+
+def fetch_bare(url):
+    with urlopen(url, timeout=5) as resp:
+        return resp.read()
+
+
+def connect(host):
+    return http.client.HTTPConnection(host, timeout=30)
+
+
+def connect_tls(host, ctx):
+    return http.client.HTTPSConnection(host, timeout=30, context=ctx)
+
+
+def raw(addr):
+    return socket.create_connection(addr, 5)
+
+
+def forwarded(url, **kw):
+    # a **kwargs splat may carry the timeout; the pass trusts it
+    return urllib.request.urlopen(url, **kw)

@@ -7,6 +7,7 @@ from tools.graftlint.passes import (
     durability,
     exception_hygiene,
     lock_discipline,
+    timeout_discipline,
     tpu_purity,
 )
 
@@ -16,6 +17,7 @@ ALL_PASSES = [
     lock_discipline,
     durability,
     exception_hygiene,
+    timeout_discipline,
     dispatch_parity,
 ]
 

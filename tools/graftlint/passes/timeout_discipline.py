@@ -1,0 +1,71 @@
+"""timeout-discipline: every outbound network call needs a timeout.
+
+Invariant: nothing in this tree may block forever on a peer.  The
+cluster path bounds every hop with a deadline-derived socket timeout
+(pilosa_tpu/cluster/client.py), but a single stray
+``urllib.request.urlopen(url)`` — in the CLI, a test helper, or a
+metrics exporter — hangs its thread indefinitely when the peer stalls,
+and Python's socket default is "no timeout".  Flag constructor/call
+sites of the blocking network entry points (``urlopen``,
+``HTTPConnection``/``HTTPSConnection``, ``socket.create_connection``)
+that pass no explicit timeout, either by keyword or positionally.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "timeout-discipline"
+DESCRIPTION = "urlopen/HTTPConnection/create_connection need explicit timeout"
+
+# call-name suffix -> index of the ``timeout`` positional parameter
+# (urlopen(url, data, timeout); HTTPConnection(host, port, timeout);
+# create_connection(address, timeout))
+_TIMEOUT_POS = {
+    "urlopen": 2,
+    "HTTPConnection": 2,
+    "HTTPSConnection": 2,
+    "create_connection": 1,
+}
+
+
+def applies(path: str) -> bool:
+    return True
+
+
+def _call_target(node: ast.Call) -> str | None:
+    """Last component of the called dotted name (``urllib.request.urlopen``
+    -> ``urlopen``), or None for computed callees."""
+    d = dotted(node.func)
+    if d is None:
+        return None
+    return d.rsplit(".", 1)[-1]
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_target(node)
+        pos = _TIMEOUT_POS.get(name)
+        if pos is None:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        # **kwargs may carry a timeout; the pass can't see through it
+        if any(kw.arg is None for kw in node.keywords):
+            continue
+        if len(node.args) > pos:
+            continue  # timeout given positionally
+        findings.append(
+            Finding(
+                path, node.lineno, node.col_offset, PASS_ID,
+                f"{name}() without an explicit timeout blocks its thread "
+                "forever on a stalled peer; pass timeout=",
+            )
+        )
+    return findings
