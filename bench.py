@@ -814,6 +814,10 @@ def main() -> None:
         ),
         **{k: round(v, 3) for k, v in serving.items()},
         "probe": _PROBE_ATTEMPTS,
+        # dispatch-lane / compile-cache / transfer accounting for the
+        # whole run: says WHICH lane produced the numbers above (a
+        # pallas-demoted round is not comparable to a pallas round)
+        "kernel_telemetry": kernels.telemetry_snapshot(),
     }
     print(json.dumps(result))
 

@@ -425,17 +425,17 @@ class InternalClient:
     # -- queries (reference http/client.go QueryNode) -----------------------
 
     def query_node(
-        self, uri: str, index: str, query: str, shards: list[int]
-    ) -> list[Any]:
-        """Execute on a remote node against its shard list; returns wire
-        results (reference executor.go:2416-2434 remoteExec)."""
-        resp = self._json(
-            "POST",
-            uri,
-            f"/index/{index}/query",
-            {"query": query, "shards": shards, "remote": True},
-        )
-        return resp["wireResults"]
+        self, uri: str, index: str, query: str, shards: list[int],
+        profile: bool = False,
+    ) -> dict:
+        """Execute on a remote node against its shard list; returns the
+        response dict — ``"wireResults"`` plus, when ``profile`` is set,
+        the remote node's ``"profile"`` sub-tree for the coordinator's
+        merge (reference executor.go:2416-2434 remoteExec)."""
+        req = {"query": query, "shards": shards, "remote": True}
+        if profile:
+            req["profile"] = True
+        return self._json("POST", uri, f"/index/{index}/query", req)
 
     # -- imports (reference http/client.go Import/ImportRoaring) ------------
 
@@ -630,8 +630,8 @@ class InternalClient:
 class NopInternalClient:
     """reference client.go:103 nopInternalClient."""
 
-    def query_node(self, uri, index, query, shards):
-        return []
+    def query_node(self, uri, index, query, shards, profile=False):
+        return {"wireResults": []}
 
     def import_bits(self, uri, index, field, req):
         pass

@@ -32,7 +32,7 @@ from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
 from pilosa_tpu.cluster.wire import decode_results
 from pilosa_tpu.exec.executor import ExecuteError, Executor, IndexNotFoundError
 from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
-from pilosa_tpu.obs import tracing
+from pilosa_tpu.obs import qprofile, tracing
 from pilosa_tpu.pql.ast import Call
 
 # Calls whose result is a Row bitmap (reference executeBitmapCallShard
@@ -147,7 +147,13 @@ class DistributedExecutor:
             for call in q.calls:
                 tcall = call.clone()
                 self.local._translate_call(idx, tcall)
-                results.append(self._execute_call(index_name, idx, tcall, shards))
+                # per-call span, matching the single-node executor's loop
+                # (executor.go:298 executeCall) — profiles and traces of
+                # clustered queries then show the same per-call shape
+                with tracing.start_span(f"executor.execute{tcall.name}"):
+                    results.append(
+                        self._execute_call(index_name, idx, tcall, shards)
+                    )
             return [
                 self.local._translate_result(idx, c, r)
                 for c, r in zip(q.calls, results)
@@ -163,7 +169,11 @@ class DistributedExecutor:
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name}")
         q = pql.parse(query) if isinstance(query, str) else query
-        return [self.local._execute_call(idx, c, shards) for c in q.calls]
+        out = []
+        for c in q.calls:
+            with tracing.start_span(f"executor.execute{c.name}"):
+                out.append(self.local._execute_call(idx, c, shards))
+        return out
 
     # -- per-call routing ---------------------------------------------------
 
@@ -252,7 +262,7 @@ class DistributedExecutor:
         out = []
         for f in concurrent.futures.as_completed(futures):
             try:
-                out.append(decode_results(f.result())[0])
+                out.append(decode_results(f.result()["wireResults"])[0])
             except ClientError as e:
                 raise ClientError(
                     f"replica write failed on node {futures[f]}: {e}", e.code
@@ -336,8 +346,9 @@ class DistributedExecutor:
                 local_shards = groups.pop(self.cluster.node_id, None)
                 futures = {
                     self._submit(
-                        self.client.query_node,
+                        self._query_remote,
                         self.cluster.node(node_id).uri,
+                        node_id,
                         index_name,
                         pql_text,
                         nshards,
@@ -351,7 +362,7 @@ class DistributedExecutor:
                 for fut in concurrent.futures.as_completed(futures):
                     node_id, nshards = futures[fut]
                     try:
-                        partials.append(decode_results(fut.result())[0])
+                        partials.append(fut.result())
                     except ClientError:
                         # Failover: re-map this node's shards onto remaining
                         # replicas (reference executor.go:2495-2506).
@@ -360,6 +371,27 @@ class DistributedExecutor:
             if not partials:
                 partials = [self.local._execute_call(idx, call, [])]
             return partials
+
+    def _query_remote(
+        self,
+        uri: str,
+        node_id: str,
+        index_name: str,
+        pql_text: str,
+        shards: list[int],
+    ) -> Any:
+        """One fan-out leg: remote query plus sub-profile graft.  When the
+        coordinator's query is being profiled the remote node returns its
+        own profile dict in the response envelope, and we hang it off the
+        current span so ``?profile=true`` shows the whole cluster tree."""
+        want = qprofile.profiling()
+        with qprofile.span("fanout", node=node_id, shards=len(shards)):
+            resp = self.client.query_node(
+                uri, index_name, pql_text, shards, profile=want
+            )
+            if want:
+                qprofile.add_subprofile(node_id, resp.get("profile"))
+            return decode_results(resp["wireResults"])[0]
 
     def _peer_available(self, node) -> bool:
         """Circuit-breaker routing check — local node is always

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu import deadline, pql
 from pilosa_tpu.core import membudget, timequantum
-from pilosa_tpu.obs import tracing
+from pilosa_tpu.obs import qprofile, tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
     FIELD_TYPE_INT,
@@ -348,6 +348,10 @@ class Executor:
             else:
                 dev = jnp.asarray(bits)
             self.stack_rebuilds += 1
+            from pilosa_tpu.ops import kernels
+
+            kernels.note_transfer(nbytes, "h2d")
+            qprofile.incr("stack_rebuilds")
             # a BSI depth autogrow (or a standard view's row-set change)
             # retires same-(mesh, shards, view) entries with a different
             # row-axis length — they can never be hit again and would
@@ -444,6 +448,7 @@ class Executor:
         entry["dev"] = dev  # dev before versions: a racing reader keyed on
         entry["versions"] = versions  # versions must never see the old dev
         self.stack_incremental += 1
+        qprofile.incr("stack_incremental")
         return slot_of, dev
 
     def _count_stat(self, idx: Index, call_name: str = "Count") -> None:
@@ -483,6 +488,7 @@ class Executor:
             cached = entry.get("gram")
             if cached is not None and cached[0] is bits:
                 self.gram_cache_hits += 1
+                qprofile.incr("gram_cache_hits")
                 return cached[1], {s: s for s in uniq}
             if (
                 2 * len(uniq) >= R
@@ -567,6 +573,7 @@ class Executor:
             cached = entry.get("rowcounts")
             if cached is not None and cached[0] is bits:
                 self.rowcount_cache_hits += 1
+                qprofile.incr("rowcount_cache_hits")
                 return cached[1]
             gram = entry.get("gram")
             if gram is not None and gram[0] is bits:
@@ -634,12 +641,14 @@ class Executor:
             entry, t = self._cross_slot(f1, bits1, f2.name)
             if t is not None and t[1]() is bits2:
                 self.crossgram_cache_hits += 1
+                qprofile.incr("crossgram_cache_hits")
                 return t[2][np.ix_(sub1, sub2)]
             # the reversed field order may already hold this gram
             # transposed (GroupBy(f, g) then GroupBy(g, f))
             _, t2 = self._cross_slot(f2, bits2, f1.name)
             if t2 is not None and t2[1]() is bits1:
                 self.crossgram_cache_hits += 1
+                qprofile.incr("crossgram_cache_hits")
                 return t2[2].T[np.ix_(sub1, sub2)]
             if entry is not None:
                 misses = entry.setdefault("crossgram_misses", {})
@@ -779,6 +788,12 @@ class Executor:
                     by_op.setdefault(op, []).append((i, sa, sb))
                 for op, olaunch in by_op.items():
                     B = _pow2(len(olaunch))
+                    if B > len(olaunch):
+                        kernels.note_pad(
+                            "pair_count",
+                            B * bits.shape[0] * 4,
+                            len(olaunch) * bits.shape[0] * 4,
+                        )
                     ras = np.zeros(B, dtype=np.int32)
                     rbs = np.zeros(B, dtype=np.int32)
                     for j, (_, sa, sb) in enumerate(olaunch):
@@ -1259,6 +1274,9 @@ class Executor:
         throughput tier — batched grams, stacks — lives in
         _batch_pair_counts/_batch_general).  Downstream Row algebra and
         counts dispatch per segment type (exec/result.py)."""
+        from pilosa_tpu.ops import kernels
+
+        kernels.record_host_op("field_row")
         out = Row(n_words=self.holder.n_words)
         if field is None:
             return out
@@ -1626,6 +1644,9 @@ class Executor:
         the worker-pool role of reference executor.go:2557-2611)."""
         if view is None:
             return 0
+        from pilosa_tpu.ops import kernels
+
+        kernels.record_host_op("host_pair_count")
         frags = [
             f for f in (view.fragment(s) for s in shard_list) if f is not None
         ]
@@ -1788,6 +1809,7 @@ class Executor:
         t = slots.get(key) if slots else None
         if t is not None and t[0] is dev:
             self.bsi_agg_cache_hits += 1
+            qprofile.incr("bsi_agg_cache_hits")
             # LRU: move the hit key to the dict end so put()'s bounded
             # eviction (front-first) removes the coldest key, not a hot
             # one that happened to be inserted early
@@ -2484,6 +2506,13 @@ class Executor:
                     for r2 in present2
                 ]
                 B = _pow2(len(combos_s))
+                if B > len(combos_s):
+                    # pow2 batch pad: padded vs useful per-shard partials
+                    kernels.note_pad(
+                        "pair_count",
+                        B * bits1.shape[0] * 4,
+                        len(combos_s) * bits1.shape[0] * 4,
+                    )
                 ras = np.zeros(B, dtype=np.int32)
                 rbs = np.zeros(B, dtype=np.int32)
                 for j, (sa, sb) in enumerate(combos_s):

@@ -20,7 +20,12 @@ _SERVICE = "pilosa-tpu"
 
 
 def _otlp_span(span) -> dict:
-    start_ns = int(time.time_ns() - (time.monotonic() - span.start) * 1e9)
+    # The span records its wall-clock anchor once at start; deriving it
+    # here from time.time_ns() would skew every batched span by however
+    # long it sat in the export queue.
+    start_ns = getattr(span, "start_unix_ns", None)
+    if start_ns is None:  # foreign span object without the anchor
+        start_ns = int(time.time_ns() - (time.monotonic() - span.start) * 1e9)
     dur_ns = int((span.duration or 0.0) * 1e9)
     return {
         "traceId": f"{span.context.trace_id & (2**128 - 1):032x}",

@@ -72,14 +72,23 @@ class NopStatsClient(StatsClient):
 NOP = NopStatsClient()
 
 
+# Prometheus-style cumulative bucket bounds.  Log-spaced seconds: wide
+# enough for sub-ms kernel launches and multi-second cluster queries.
+HISTOGRAM_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
 class _Histo:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "buckets")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self.buckets = [0] * len(HISTOGRAM_BUCKETS)
 
     def observe(self, v: float) -> None:
         self.count += 1
@@ -88,6 +97,9 @@ class _Histo:
             self.min = v
         if v > self.max:
             self.max = v
+        for i, bound in enumerate(HISTOGRAM_BUCKETS):
+            if v <= bound:
+                self.buckets[i] += 1
 
     def to_dict(self) -> dict:
         return {
@@ -95,6 +107,9 @@ class _Histo:
             "sum": self.total,
             "min": self.min if self.count else None,
             "max": self.max if self.count else None,
+            "buckets": {
+                str(b): c for b, c in zip(HISTOGRAM_BUCKETS, self.buckets)
+            },
         }
 
 
@@ -268,6 +283,16 @@ def _prom_labels(tags: tuple[str, ...]) -> str:
     return "{" + ",".join(parts) + "}"
 
 
+def _prom_le_labels(tags: tuple[str, ...], bound) -> str:
+    """Labels with the histogram ``le`` bucket bound merged in."""
+    parts = []
+    for t in tags:
+        k, _, v = t.partition(":")
+        parts.append(f'{_prom_name(k)}="{v}"')
+    parts.append(f'le="{bound}"')
+    return "{" + ",".join(parts) + "}"
+
+
 def prometheus_text(client: StatsClient) -> str:
     """Render a MemStatsClient in Prometheus text exposition format
     (reference prometheus/prometheus.go:52, route http/handler.go:282)."""
@@ -277,7 +302,10 @@ def prometheus_text(client: StatsClient) -> str:
     with client._lock:
         counters = dict(client._counters)
         gauges = dict(client._gauges)
-        histos = {k: (h.count, h.total) for k, h in client._histograms.items()}
+        histos = {
+            k: (h.count, h.total, list(h.buckets))
+            for k, h in client._histograms.items()
+        }
         sets = {k: len(s) for k, s in client._sets.items()}
     seen: set[str] = set()
 
@@ -294,9 +322,12 @@ def prometheus_text(client: StatsClient) -> str:
         n = "pilosa_" + _prom_name(name)
         typ(n, "gauge")
         out.append(f"{n}{_prom_labels(tags)} {v}")
-    for (name, tags), (cnt, total) in sorted(histos.items()):
+    for (name, tags), (cnt, total, buckets) in sorted(histos.items()):
         n = "pilosa_" + _prom_name(name)
-        typ(n, "summary")
+        typ(n, "histogram")
+        for bound, bcnt in zip(HISTOGRAM_BUCKETS, buckets):
+            out.append(f"{n}_bucket{_prom_le_labels(tags, bound)} {bcnt}")
+        out.append(f'{n}_bucket{_prom_le_labels(tags, "+Inf")} {cnt}')
         out.append(f"{n}_count{_prom_labels(tags)} {cnt}")
         out.append(f"{n}_sum{_prom_labels(tags)} {total}")
     for (name, tags), card in sorted(sets.items()):

@@ -25,6 +25,8 @@ import threading
 import time
 from collections import deque
 
+from pilosa_tpu.obs import qprofile
+
 TRACE_HEADER = "X-Pilosa-Trace-Id"
 SPAN_HEADER = "X-Pilosa-Span-Id"
 
@@ -54,9 +56,13 @@ class Span:
         trace_id = parent.trace_id if parent else next(_ids)
         self.context = SpanContext(trace_id, next(_ids))
         self.start = time.monotonic()
+        # wall-clock anchor, taken once at span start: exporters must not
+        # re-derive it at export time (batched exports would skew it)
+        self.start_unix_ns = time.time_ns()
         self.duration = None
         self.tags: dict = {}
         self._token = None
+        self._phandle = None
 
     def set_tag(self, key: str, value) -> "Span":
         self.tags[key] = value
@@ -70,12 +76,18 @@ class Span:
             self.duration = time.monotonic() - self.start
             self.tracer._record(self)
 
-    # context-manager + ambient-activation protocol
+    # context-manager + ambient-activation protocol.  Every span is
+    # also mirrored into the active QueryProfile (if any) — this runs
+    # for the NopTracer too, which is how ``?profile=true`` sees the
+    # call tree without a tracing backend configured.
     def __enter__(self) -> "Span":
         self._token = _active_span.set(self)
+        self._phandle = qprofile.span_enter(self.name)
         return self
 
     def __exit__(self, *exc) -> None:
+        qprofile.span_exit(self._phandle, self.tags)
+        self._phandle = None
         if self._token is not None:
             _active_span.reset(self._token)
             self._token = None

@@ -40,6 +40,8 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
 from __future__ import annotations
 
 import os
+import threading
+import time
 from functools import lru_cache, partial
 
 import numpy as np
@@ -52,6 +54,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.compat import shard_map
 
+from pilosa_tpu.obs import qprofile
+from pilosa_tpu.obs.stats import MemStatsClient
 from pilosa_tpu.ops.bitops import pow2_pad_len
 
 _OPS = {
@@ -121,25 +125,181 @@ _pallas_ok: bool | None = None
 # (an established _pallas_ok=True): device OOM or a miscompiled shape
 # would otherwise become invisible performance degradation.  Surfaced via
 # diagnostics (pallas_fallbacks) so operators can see repeated failures.
+# Dispatch runs on the HTTP request pool, so the counter is locked.
 _pallas_fallbacks: int = 0
 _PALLAS_FALLBACK_LOG_EVERY = 10
+_fallback_lock = threading.Lock()
+
+# Process-wide kernel/dispatch telemetry, rendered as ``pilosa_kernel_*``
+# by /metrics and snapshotted into /debug/vars and bench records.  Lives
+# here rather than on the holder because dispatch decisions are made in
+# this module, below any holder plumbing.
+kernel_stats = MemStatsClient()
+
+_dispatch_lock = threading.Lock()
+_seen_programs: set = set()
+_MAX_SEEN_PROGRAMS = 4096
 
 
 def pallas_fallback_count() -> int:
-    return _pallas_fallbacks
+    with _fallback_lock:
+        return _pallas_fallbacks
 
 
 def _note_pallas_fallback(exc: Exception) -> None:
     global _pallas_fallbacks
-    _pallas_fallbacks += 1
-    if _pallas_fallbacks % _PALLAS_FALLBACK_LOG_EVERY == 1:
+    with _fallback_lock:
+        _pallas_fallbacks += 1
+        n = _pallas_fallbacks
+    kernel_stats.count("kernel_pallas_fallbacks")
+    if n % _PALLAS_FALLBACK_LOG_EVERY == 1:
         import logging
 
         logging.getLogger("pilosa_tpu.kernels").warning(
             "pallas kernel demoted to XLA fallback (#%d): %r",
-            _pallas_fallbacks,
+            n,
             exc,
         )
+
+
+def _fn_kernel_name(fn) -> str:
+    """Human kernel name from a dispatch target (lane/builder suffixes
+    stripped so pallas/xla variants of one kernel share a name)."""
+    name = getattr(fn, "__name__", None)
+    if name is None:
+        name = getattr(getattr(fn, "func", None), "__name__", None) or "kernel"
+    for suffix in ("_sharded_fn", "_pallas", "_xla"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    return name.lstrip("_")
+
+
+def _shape_sig(args) -> tuple:
+    return tuple(
+        tuple(a.shape) for a in args if getattr(a, "shape", None) is not None
+    )
+
+
+def _note_dispatch(
+    kernel: str,
+    lane: str,
+    *,
+    wall: float | None = None,
+    args=(),
+    demoted: bool = False,
+    padded_bytes: int = 0,
+    useful_bytes: int = 0,
+) -> None:
+    """Record one kernel dispatch: tagged counters/timings into
+    ``kernel_stats`` plus a per-kernel record into the active query
+    profile.  ``wall`` is launch wall time — device work may still be in
+    flight unless the caller synchronized.  The jit compile-cache
+    hit/miss is a proxy: first sight of (kernel, lane, arg shapes) in
+    this process, mirroring XLA's shape-keyed jit cache."""
+    key = (kernel, lane, _shape_sig(args))
+    with _dispatch_lock:
+        miss = key not in _seen_programs
+        if miss and len(_seen_programs) < _MAX_SEEN_PROGRAMS:
+            _seen_programs.add(key)
+    tagged = kernel_stats.with_tags(f"kernel:{kernel}", f"lane:{lane}")
+    tagged.count("kernel_dispatch")
+    kernel_stats.count(
+        "kernel_compile_misses" if miss else "kernel_compile_hits"
+    )
+    if demoted:
+        tagged.count("kernel_demotions")
+    if padded_bytes:
+        tagged.count("kernel_padded_bytes", int(padded_bytes))
+        tagged.count("kernel_useful_bytes", int(useful_bytes))
+    if wall is not None:
+        tagged.timing("kernel_dispatch", wall)
+    rec: dict = {
+        "kernel": kernel,
+        "lane": lane,
+        "jit_cache": "miss" if miss else "hit",
+    }
+    if wall is not None:
+        rec["wall_ms"] = round(wall * 1e3, 3)
+    if demoted:
+        rec["demoted"] = True
+    if padded_bytes:
+        rec["padded_bytes"] = int(padded_bytes)
+        rec["useful_bytes"] = int(useful_bytes)
+    qprofile.record_kernel(**rec)
+
+
+def note_transfer(nbytes: int, direction: str) -> None:
+    """Count host<->device traffic (``direction``: "h2d" | "d2h")."""
+    if nbytes:
+        kernel_stats.with_tags(f"direction:{direction}").count(
+            "kernel_transfer_bytes", int(nbytes)
+        )
+        qprofile.incr(f"transfer_{direction}_bytes", int(nbytes))
+
+
+def note_pad(kernel: str, padded_bytes: int, useful_bytes: int) -> None:
+    """Padding accounting for pow2 batch/gather padding (callers that
+    know the padded and useful extents but dispatch elsewhere)."""
+    tagged = kernel_stats.with_tags(f"kernel:{kernel}")
+    tagged.count("kernel_padded_bytes", int(padded_bytes))
+    tagged.count("kernel_useful_bytes", int(useful_bytes))
+
+
+def _pull(out) -> np.ndarray:
+    """Materialize a device result on the host, counting the d2h bytes."""
+    arr = np.asarray(out)
+    note_transfer(arr.nbytes, "d2h")
+    return arr
+
+
+def record_host_op(kernel: str) -> None:
+    """Executor host-path ops (python/numpy row materialization) report
+    through the same telemetry under lane=host."""
+    _note_dispatch(kernel, "host")
+
+
+def telemetry_snapshot() -> dict:
+    """JSON-safe kernel-telemetry rollup for /debug/vars, bench records
+    and tests: dispatch-lane counts, compile-cache proxy, transfer
+    bytes, pallas gate states."""
+    snap = kernel_stats.snapshot()
+    lanes: dict[str, int] = {}
+    transfers: dict[str, int] = {}
+    compile_cache = {"hits": 0, "misses": 0}
+    for label, v in snap["counters"].items():
+        name, _, tagstr = label.partition("{")
+        tags = dict(
+            t.split(":", 1) for t in tagstr.rstrip("}").split(",") if ":" in t
+        )
+        if name == "kernel_dispatch":
+            lane = tags.get("lane", "?")
+            lanes[lane] = lanes.get(lane, 0) + int(v)
+        elif name == "kernel_transfer_bytes":
+            d = tags.get("direction", "?")
+            transfers[d] = transfers.get(d, 0) + int(v)
+        elif name == "kernel_compile_hits":
+            compile_cache["hits"] += int(v)
+        elif name == "kernel_compile_misses":
+            compile_cache["misses"] += int(v)
+    return {
+        "pallas_supported": pallas_supported(),
+        "pallas_ok": _pallas_ok,
+        "pallas_fallbacks": pallas_fallback_count(),
+        "gram_gates": {
+            "self": {
+                "ok": _self_gram_gate.ok,
+                "fails": _self_gram_gate.fails,
+            },
+            "cross": {
+                "ok": _cross_gram_gate.ok,
+                "fails": _cross_gram_gate.fails,
+            },
+        },
+        "dispatch_lanes": lanes,
+        "compile_cache": compile_cache,
+        "transfer_bytes": transfers,
+        "counters": snap["counters"],
+    }
 
 
 def _multi_device(x) -> bool:
@@ -241,13 +401,18 @@ def _run_sharded(builder, builder_args, call_args) -> jax.Array:
     Builders take a trailing ``use_pallas`` flag; XLA-only kernels call
     their jit(shard_map) builder directly instead."""
     global _pallas_ok
+    kname = _fn_kernel_name(builder)
     use_pallas = pallas_supported() and _pallas_ok is not False
     if use_pallas:
         try:
+            t0 = time.perf_counter()
             out = builder(*builder_args, True)(*call_args)
             if _pallas_ok is None:
                 jax.block_until_ready(out)
                 _pallas_ok = True
+            _note_dispatch(
+                kname, "pallas", wall=time.perf_counter() - t0, args=call_args
+            )
             return out
         except Exception as exc:
             # match _try_pallas: an established True flag survives a
@@ -256,7 +421,16 @@ def _run_sharded(builder, builder_args, call_args) -> jax.Array:
                 _pallas_ok = False
             else:
                 _note_pallas_fallback(exc)
-    return builder(*builder_args, False)(*call_args)
+    t0 = time.perf_counter()
+    out = builder(*builder_args, False)(*call_args)
+    _note_dispatch(
+        kname,
+        "xla",
+        wall=time.perf_counter() - t0,
+        args=call_args,
+        demoted=use_pallas,
+    )
+    return out
 
 
 def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
@@ -270,19 +444,43 @@ def _try_pallas(fn, fallback, *args, **kwargs) -> jax.Array:
         or not pallas_supported()
         or any(_multi_device(a) for a in args)
     ):
-        return fallback(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fallback(*args, **kwargs)
+        _note_dispatch(
+            _fn_kernel_name(fallback),
+            "xla",
+            wall=time.perf_counter() - t0,
+            args=args,
+        )
+        return out
     try:
+        t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         if _pallas_ok is None:
             jax.block_until_ready(out)
             _pallas_ok = True
+        _note_dispatch(
+            _fn_kernel_name(fn),
+            "pallas",
+            wall=time.perf_counter() - t0,
+            args=args,
+        )
         return out
     except Exception as exc:
         if _pallas_ok is None:
             _pallas_ok = False
         else:
             _note_pallas_fallback(exc)
-        return fallback(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fallback(*args, **kwargs)
+        _note_dispatch(
+            _fn_kernel_name(fallback),
+            "xla",
+            wall=time.perf_counter() - t0,
+            args=args,
+            demoted=True,
+        )
+        return out
 
 
 def pair_count_batched(
@@ -308,9 +506,21 @@ def pair_count_batched(
             hi, lo = _psum_chunked_fn(mesh, axis, "pair:" + op, chunk)(
                 bits, ras, rbs
             )
-            return _hi_lo_total(hi, lo)
-        return _pair_count_sharded_fn(mesh, axis, op, False)(bits, ras, rbs)
-    return pair_count_batched_xla(bits, ras, rbs, op=op)
+            out = _hi_lo_total(hi, lo)
+            _note_dispatch("pair_count", "xla", args=(bits, ras))
+            return out
+        t0 = time.perf_counter()
+        out = _pair_count_sharded_fn(mesh, axis, op, False)(bits, ras, rbs)
+        _note_dispatch(
+            "pair_count", "xla", wall=time.perf_counter() - t0, args=(bits, ras)
+        )
+        return out
+    t0 = time.perf_counter()
+    out = pair_count_batched_xla(bits, ras, rbs, op=op)
+    _note_dispatch(
+        "pair_count", "xla", wall=time.perf_counter() - t0, args=(bits, ras)
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -490,7 +700,7 @@ def gram_matrix_traced(bits: jax.Array) -> jax.Array:
     return gram_matrix_xla(bits)
 
 
-def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
+def _with_gram_fallback(pallas_fn, fallback_fn, gate=None, kernel="gram"):
     """The gram family's shared probe/demote contract: the first success
     proves the gate; every failure — probe-time or proven — is answered
     by ``fallback_fn``, counted visibly, and charged against
@@ -507,9 +717,11 @@ def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
         # runtime failure (e.g. device OOM) surface at the caller's
         # np.asarray instead of being re-answered by the fallback — and
         # every call site pulls the result immediately anyway
+        t0 = time.perf_counter()
         out = jax.block_until_ready(pallas_fn())
         if gate.ok is None:
             gate.ok = True
+        _note_dispatch(kernel, "pallas", wall=time.perf_counter() - t0)
         return out
     except Exception as exc:
         probing = gate.ok is None
@@ -529,7 +741,12 @@ def _with_gram_fallback(pallas_fn, fallback_fn, gate=None):
                 "; kernel family disabled" if gate.ok is False else "",
                 exc,
             )
-        return fallback_fn()
+        t0 = time.perf_counter()
+        out = fallback_fn()
+        _note_dispatch(
+            kernel, "xla", wall=time.perf_counter() - t0, demoted=True
+        )
+        return out
 
 
 def gram_matrix(bits: jax.Array) -> jax.Array:
@@ -537,9 +754,16 @@ def gram_matrix(bits: jax.Array) -> jax.Array:
     otherwise or on any Pallas failure."""
     _, R, W = bits.shape
     if _multi_device(bits) or not _gram_pallas_eligible(R, W):
-        return gram_matrix_xla(bits)
+        t0 = time.perf_counter()
+        out = gram_matrix_xla(bits)
+        _note_dispatch(
+            "gram_matrix", "xla", wall=time.perf_counter() - t0, args=(bits,)
+        )
+        return out
     return _with_gram_fallback(
-        lambda: gram_matrix_traced(bits), lambda: gram_matrix_xla(bits)
+        lambda: gram_matrix_traced(bits),
+        lambda: gram_matrix_xla(bits),
+        kernel="gram_matrix",
     )
 
 
@@ -598,8 +822,14 @@ def gram_gather(bits: jax.Array, idx: jax.Array) -> jax.Array:
         return _with_gram_fallback(
             lambda: _gram_gather_fused(bits, idx),
             lambda: gram_gather_xla(bits, idx),
+            kernel="gram_gather",
         )
-    return gram_gather_xla(bits, idx)
+    t0 = time.perf_counter()
+    out = gram_gather_xla(bits, idx)
+    _note_dispatch(
+        "gram_gather", "xla", wall=time.perf_counter() - t0, args=(bits, idx)
+    )
+    return out
 
 
 # Largest pair total an int32 gram accumulator may reach (tests shrink it
@@ -817,9 +1047,7 @@ def _psum_chunk_size(mesh, w: int) -> int:
 
 
 def _hi_lo_total(hi, lo) -> np.ndarray:
-    return np.asarray(hi).astype(np.int64) * 2**32 + np.asarray(lo).astype(
-        np.int64
-    )
+    return _pull(hi).astype(np.int64) * 2**32 + _pull(lo).astype(np.int64)
 
 
 def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
@@ -848,6 +1076,9 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
         Up = pow2_pad_len(U)
         idx = np.zeros(Up, np.int32)
         idx[:U] = row_idx
+        if Up > U:
+            # padded vs useful gather-subset bytes ([S, Up, W] uint32)
+            note_pad("pair_gram", S * Up * W * 4, S * U * W * 4)
     m = shards_axis_of(bits)
     if m is not None:
         mesh, axis = m
@@ -857,7 +1088,7 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
             if _gram_int32_safe(S, W):
                 fn = _gram_mesh_fn(mesh, axis, not full, True)
                 out = fn(bits) if full else fn(bits, jnp.asarray(idx))
-                return np.asarray(out).astype(np.int64)[:U, :U]
+                return _pull(out).astype(np.int64)[:U, :U]
             chunk = _psum_chunk_size(mesh, W)
             if chunk < 1:
                 return None
@@ -882,17 +1113,21 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
 
         if use_p:
             out = _with_gram_fallback(
-                lambda: _run(True), lambda: _run(False)
+                lambda: _run(True), lambda: _run(False), kernel="pair_gram"
             )
         else:
+            t0 = time.perf_counter()
             out = _run(False)
-        return np.asarray(out).astype(np.int64).sum(axis=0)[:U, :U]
+            _note_dispatch(
+                "pair_gram", "xla", wall=time.perf_counter() - t0, args=(bits,)
+            )
+        return _pull(out).astype(np.int64).sum(axis=0)[:U, :U]
     if _gram_int32_safe(S, W):
         if full:
             out = gram_matrix(bits)
         else:
             out = gram_gather(bits, jnp.asarray(idx))
-        return np.asarray(out).astype(np.int64)[:U, :U]
+        return _pull(out).astype(np.int64)[:U, :U]
     # Giant single-device index: chunk the shard axis so each chunk's
     # partial gram is int32-exact, and sum the chunks in host int64
     # (int64 on device is unavailable without jax_enable_x64).
@@ -903,7 +1138,7 @@ def pair_gram(bits: jax.Array, row_idx) -> np.ndarray | None:
         out = gram_matrix(blk) if full else gram_gather(
             blk, jnp.asarray(idx)
         )
-        total += np.asarray(out).astype(np.int64)
+        total += _pull(out).astype(np.int64)
     return total[:U, :U]
 
 
@@ -1053,11 +1288,20 @@ def cross_gram_gather(
         or _multi_device(bits_b)
         or not _cross_pallas_engages(Ua, Ub, W)
     ):
-        return cross_gram_gather_xla(bits_a, bits_b, ia, ib)
+        t0 = time.perf_counter()
+        out = cross_gram_gather_xla(bits_a, bits_b, ia, ib)
+        _note_dispatch(
+            "cross_gram_gather",
+            "xla",
+            wall=time.perf_counter() - t0,
+            args=(bits_a, ia, ib),
+        )
+        return out
     return _with_gram_fallback(
         lambda: _cross_gram_gather_fused(bits_a, bits_b, ia, ib),
         lambda: cross_gram_gather_xla(bits_a, bits_b, ia, ib),
         gate=_cross_gram_gate,
+        kernel="cross_gram_gather",
     )
 
 
@@ -1108,6 +1352,12 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
     ia[:Ua] = idx_a
     ib = np.zeros(pow2_pad_len(Ub), np.int32)
     ib[:Ub] = idx_b
+    if len(ia) > Ua or len(ib) > Ub:
+        note_pad(
+            "cross_pair_gram",
+            S * (len(ia) + len(ib)) * W * 4,
+            S * (Ua + Ub) * W * 4,
+        )
     m = shards_axis_of(bits_a)
     if m is not None and shards_axis_of(bits_b) == m:
         mesh, axis = m
@@ -1117,7 +1367,7 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
                 out = _cross_gram_psum_fn(mesh, axis)(
                     bits_a, bits_b, jnp.asarray(ia), jnp.asarray(ib)
                 )
-                return np.asarray(out).astype(np.int64)[:Ua, :Ub]
+                return _pull(out).astype(np.int64)[:Ua, :Ub]
             chunk = _psum_chunk_size(mesh, W)
             if chunk < 1:
                 return None
@@ -1130,20 +1380,20 @@ def cross_pair_gram(bits_a: jax.Array, bits_b: jax.Array, idx_a, idx_b):
         out = _cross_gram_sharded_fn(mesh, axis)(
             bits_a, bits_b, jnp.asarray(ia), jnp.asarray(ib)
         )
-        return np.asarray(out).astype(np.int64).sum(axis=0)[:Ua, :Ub]
+        return _pull(out).astype(np.int64).sum(axis=0)[:Ua, :Ub]
     if m is not None or shards_axis_of(bits_b) is not None:
         return None  # mismatched shardings; scan kernels handle it
     ia_d, ib_d = jnp.asarray(ia), jnp.asarray(ib)
     if _gram_int32_safe(S, W):
         out = cross_gram_gather(bits_a, bits_b, ia_d, ib_d)
-        return np.asarray(out).astype(np.int64)[:Ua, :Ub]
+        return _pull(out).astype(np.int64)[:Ua, :Ub]
     chunk = max(1, _GRAM_ACC_LIMIT // (W * 32))
     total = np.zeros((len(ia), len(ib)), np.int64)
     for c0 in range(0, S, chunk):
         out = cross_gram_gather(
             bits_a[c0 : c0 + chunk], bits_b[c0 : c0 + chunk], ia_d, ib_d
         )
-        total += np.asarray(out).astype(np.int64)
+        total += _pull(out).astype(np.int64)
     return total[:Ua, :Ub]
 
 
@@ -1190,11 +1440,29 @@ def pair_count_two_batched(
             hi, lo = _psum_chunked_fn(mesh, axis, "pair2:" + op, chunk)(
                 bits_a, bits_b, ras, rbs
             )
-            return _hi_lo_total(hi, lo)
-        return _pair_count_sharded_fn(mesh, axis, op, True)(
+            out = _hi_lo_total(hi, lo)
+            _note_dispatch("pair_count_two", "xla", args=(bits_a, ras))
+            return out
+        t0 = time.perf_counter()
+        out = _pair_count_sharded_fn(mesh, axis, op, True)(
             bits_a, bits_b, ras, rbs
         )
-    return pair_count_two_batched_xla(bits_a, bits_b, ras, rbs, op=op)
+        _note_dispatch(
+            "pair_count_two",
+            "xla",
+            wall=time.perf_counter() - t0,
+            args=(bits_a, ras),
+        )
+        return out
+    t0 = time.perf_counter()
+    out = pair_count_two_batched_xla(bits_a, bits_b, ras, rbs, op=op)
+    _note_dispatch(
+        "pair_count_two",
+        "xla",
+        wall=time.perf_counter() - t0,
+        args=(bits_a, ras),
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1360,10 +1628,18 @@ def combo_counts_gram(prefix: jax.Array, bits: jax.Array, idx) -> np.ndarray | N
             lambda: _combo_gram_fused(prefix, bits, idx_dev),
             lambda: _combo_gram_xla(prefix, bits, idx_dev),
             gate=_cross_gram_gate,
+            kernel="combo_gram",
         )
     else:
+        t0 = time.perf_counter()
         out = _combo_gram_xla(prefix, bits, idx_dev)
-    return np.asarray(out).astype(np.int64)
+        _note_dispatch(
+            "combo_gram",
+            "xla",
+            wall=time.perf_counter() - t0,
+            args=(prefix, bits, idx_dev),
+        )
+    return _pull(out).astype(np.int64)
 
 
 @jax.jit

@@ -11,11 +11,13 @@ from __future__ import annotations
 import io
 import logging
 import threading
+import time
 from typing import Any
 
 import numpy as np
 
 from pilosa_tpu import __version__, deadline
+from pilosa_tpu.obs import qprofile
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core import timequantum
@@ -96,6 +98,10 @@ class API:
             )
         self._lock = threading.RLock()
         self._state = STATE_NORMAL
+        # Slow-query ring (reference long-query-time log line, upgraded
+        # to full profiles at /debug/slow-queries); the server sets the
+        # threshold from config.
+        self.slow_queries = qprofile.SlowQueryLog()
         # Diagnostics collector; NodeServer installs one (reference
         # server.go diagnostics wiring).
         self.diagnostics = None
@@ -157,11 +163,17 @@ class API:
         pql: str,
         shards: list[int] | None = None,
         remote: bool = False,
+        profile: bool = False,
     ) -> dict:
         """reference api.go:134 Query. ``remote=True`` marks a mapped
         sub-query from another node's coordinator (reference Remote:true
         QueryRequest): keys arrive pre-translated, results return in wire
-        encoding for the caller's reduce step."""
+        encoding for the caller's reduce step.  ``profile=True`` returns
+        the per-query call tree (spans, kernel dispatches, cache hits,
+        remote sub-profiles) under ``"profile"`` alongside the results;
+        a profile is also collected — without being returned — whenever
+        the slow-query log is armed, so threshold breaches capture a
+        full tree."""
         self._validate("Query")
         # Fail fast if the budget is already spent (e.g. a forwarded
         # sub-query whose header arrived expired) — DeadlineExceeded is
@@ -170,19 +182,42 @@ class API:
         deadline.check(f"query on {index!r}")
         from pilosa_tpu.pql import ParseError
 
+        prof = None
+        if profile or self.slow_queries.enabled:
+            node_id = getattr(self.cluster, "node_id", "") if self.cluster else ""
+            prof = qprofile.QueryProfile(index, pql, node_id=node_id)
+        t0 = time.perf_counter()
+        err = None
         try:
-            if remote and self.dist is not None:
-                from pilosa_tpu.cluster.wire import encode_results
+            with qprofile.activate(prof):
+                try:
+                    if remote and self.dist is not None:
+                        from pilosa_tpu.cluster.wire import encode_results
 
-                results = self.dist.execute_remote(index, pql, shards)
-                return {"wireResults": encode_results(results)}
-            if self.dist is not None:
-                results = self.dist.execute(index, pql, shards=shards)
-            else:
-                results = self.executor.execute(index, pql, shards=shards)
-        except (ExecuteError, ParseError, ValueError, TypeError) as e:
-            raise ApiError(str(e))
-        return {"results": result_to_json(results)}
+                        results = self.dist.execute_remote(index, pql, shards)
+                        resp = {"wireResults": encode_results(results)}
+                    elif self.dist is not None:
+                        results = self.dist.execute(index, pql, shards=shards)
+                        resp = {"results": result_to_json(results)}
+                    else:
+                        results = self.executor.execute(
+                            index, pql, shards=shards
+                        )
+                        resp = {"results": result_to_json(results)}
+                except (ExecuteError, ParseError, ValueError, TypeError) as e:
+                    err = str(e)
+                    raise ApiError(str(e))
+        except BaseException as e:
+            if err is None:
+                err = repr(e)  # timeouts etc. still land in the slow log
+            raise
+        finally:
+            if prof is not None:
+                prof.finish(time.perf_counter() - t0, error=err)
+                self.slow_queries.observe(prof)
+        if prof is not None and profile:
+            resp["profile"] = prof.to_dict()
+        return resp
 
     # -- schema CRUD (reference api.go:161-495) -----------------------------
 

@@ -49,6 +49,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/slow-queries$"), "debug_slow_queries"),
     ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
     ("GET", re.compile(r"^/debug/memory$"), "debug_memory"),
@@ -220,12 +221,20 @@ class Handler(BaseHTTPRequestHandler):
         self._send_json(200, self.api.schema())
 
     def r_metrics(self):
-        """Prometheus text exposition (reference http/handler.go:282)."""
+        """Prometheus text exposition (reference http/handler.go:282).
+        Kernel-dispatch telemetry lives in its own process-global
+        registry (ops/kernels.kernel_stats) so it is visible even when
+        the holder uses a NopStatsClient; both registries are rendered
+        into the one scrape."""
         from pilosa_tpu.obs.stats import prometheus_text
+        from pilosa_tpu.ops import kernels
 
+        text = prometheus_text(self.api.holder.stats) + prometheus_text(
+            kernels.kernel_stats
+        )
         self._send(
             200,
-            prometheus_text(self.api.holder.stats).encode(),
+            text.encode(),
             content_type="text/plain; version=0.0.4",
         )
 
@@ -246,7 +255,18 @@ class Handler(BaseHTTPRequestHandler):
                 "stack_incremental": ex.stack_incremental,
                 "bsi_stack_launches": ex.bsi_stack_launches,
             }
+        from pilosa_tpu.ops import kernels
+
+        snap["kernels"] = kernels.telemetry_snapshot()
         self._send_json(200, snap)
+
+    def r_debug_slow_queries(self):
+        """Bounded worst-offender log of queries over the server's
+        slow-query threshold (reference's long-query-time logging,
+        handler.go:246-248, upgraded to a structured endpoint: each
+        entry keeps the full execution profile of the offending
+        query)."""
+        self._send_json(200, self.api.slow_queries.snapshot())
 
     def r_debug_threads(self):
         """Per-thread stack dump — the pprof goroutine-profile analogue
@@ -317,6 +337,7 @@ class Handler(BaseHTTPRequestHandler):
         internal/public.proto)."""
         body = self._body()
         remote = False
+        profile = False
         shards = None
         pql = body.decode()
         if self.headers.get("Content-Type", "").startswith("application/json"):
@@ -328,6 +349,7 @@ class Handler(BaseHTTPRequestHandler):
                 pql = obj.get("query", "")
                 shards = obj.get("shards")
                 remote = bool(obj.get("remote"))
+                profile = bool(obj.get("profile"))
         if "shards" in self.query_params:
             shards = [
                 int(s)
@@ -335,7 +357,14 @@ class Handler(BaseHTTPRequestHandler):
                 for s in part.split(",")
                 if s
             ]
-        self._send_json(200, self.api.query(index, pql, shards=shards, remote=remote))
+        if self.query_params.get("profile", [""])[0].lower() in ("1", "true"):
+            profile = True
+        self._send_json(
+            200,
+            self.api.query(
+                index, pql, shards=shards, remote=remote, profile=profile
+            ),
+        )
 
     def r_create_index(self, index: str):
         body = self._json_body()
@@ -503,7 +532,10 @@ class Server:
         tls_cert: str | None = None,
         tls_key: str | None = None,
         default_deadline: float = 0.0,
+        slow_query_time: float = 0.0,
     ):
+        if slow_query_time > 0:
+            api.slow_queries.threshold = slow_query_time
         handler = type(
             "BoundHandler",
             (Handler,),

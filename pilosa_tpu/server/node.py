@@ -52,6 +52,7 @@ class NodeServer:
         client_retry_budget: int = 2,
         breaker_threshold: int = 5,
         breaker_cooldown: float = 2.0,
+        slow_query_time: float = 0.0,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -110,6 +111,7 @@ class NodeServer:
             tls_cert=tls_cert,
             tls_key=tls_key,
             default_deadline=default_deadline,
+            slow_query_time=slow_query_time,
         )
         # Diagnostics + runtime metrics loops (reference server.go:433-436
         # monitorDiagnostics/monitorRuntime, gcnotify).

@@ -26,9 +26,11 @@ class InProcessCluster:
         n_words: int = SHARD_WORDS,
         with_disk: bool = False,
         long_query_time: float = 0.0,
+        slow_query_time: float = 0.0,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
+        self._slow_query_time = slow_query_time
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).
         self._next_node_num = n
@@ -39,6 +41,7 @@ class InProcessCluster:
                 replica_n=replica_n,
                 n_words=n_words,
                 long_query_time=long_query_time,
+                slow_query_time=slow_query_time,
             )
             node.start()
             self.nodes.append(node)
@@ -76,8 +79,8 @@ class InProcessCluster:
     def create_field(self, index: str, field: str, options: dict | None = None) -> None:
         self.nodes[0].api.create_field(index, field, options or {})
 
-    def query(self, node: int, index: str, pql: str) -> dict:
-        return self.nodes[node].api.query(index, pql)
+    def query(self, node: int, index: str, pql: str, profile: bool = False) -> dict:
+        return self.nodes[node].api.query(index, pql, profile=profile)
 
     def import_bits(self, index: str, field: str, bits: list[tuple[int, int]]) -> None:
         """Route (row, col) pairs through node 0's import coordinator
@@ -110,6 +113,7 @@ class InProcessCluster:
             replica_n=self.nodes[0].cluster.replica_n,
             n_words=self.nodes[0].holder.n_words,
             long_query_time=self.nodes[0].server.httpd.RequestHandlerClass.long_query_time,
+            slow_query_time=self._slow_query_time,
         )
         node.start()
         try:

@@ -22,6 +22,7 @@ PER_FILE = [
     "durability",
     "exception_hygiene",
     "timeout_discipline",
+    "span_discipline",
 ]
 
 
@@ -88,6 +89,11 @@ class TestBadCorpusCoverage:
         assert "HTTPConnection" in msgs
         assert "HTTPSConnection" in msgs
         assert "create_connection" in msgs
+
+    def test_span_classes(self):
+        msgs = " | ".join(self._msgs("span_discipline"))
+        assert "no tracing span" in msgs
+        assert "bypasses the span-injecting" in msgs
 
 
 class TestDispatchParity:

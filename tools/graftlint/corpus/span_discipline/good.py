@@ -1,0 +1,42 @@
+"""span-discipline good corpus: spanned execute-path twins and a client
+whose public surface routes through the _do layer."""
+
+from obs import tracing  # corpus stand-in
+
+
+def _batch_pair_counts(ops, stacks):
+    with tracing.start_span("executor.batchPairCount"):
+        out = []
+        for op in ops:
+            out.append(len(stacks))
+        return out
+
+
+class Executor:
+    def execute(self, index, query, shards):
+        with tracing.start_span("executor.Execute"):
+            results = []
+            for call in query.calls:
+                results.append(self._execute_call(index, call, shards))
+            return results
+
+    def _execute_call(self, index, call, shards):
+        return call
+
+
+class InternalClient:
+    def _do_full(self, method, uri, path, body=None):
+        headers = {}
+        span = tracing.active_span()
+        if span is not None:
+            tracing.get_tracer().inject_headers(span.context, headers)
+        return self._pool.request(method, uri + path, body, headers, timeout=5)
+
+    def _json(self, method, uri, path, obj=None):
+        return self._do_full(method, uri, path, obj)[0]
+
+    def query_node(self, uri, index, query, shards):
+        return self._json("POST", uri, f"/index/{index}/query", query)
+
+    def status(self, uri):
+        return self._json("GET", uri, "/status")

@@ -7,6 +7,7 @@ from tools.graftlint.passes import (
     durability,
     exception_hygiene,
     lock_discipline,
+    span_discipline,
     timeout_discipline,
     tpu_purity,
 )
@@ -18,6 +19,7 @@ ALL_PASSES = [
     durability,
     exception_hygiene,
     timeout_discipline,
+    span_discipline,
     dispatch_parity,
 ]
 

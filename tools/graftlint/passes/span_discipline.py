@@ -1,0 +1,103 @@
+"""span-discipline: the query hot path must stay traceable.
+
+Two invariants the profiling plane (obs/qprofile.py, ``?profile=true``)
+depends on — a span-less stretch of the execute path is a blind spot in
+every profile and every exported trace:
+
+* the executor entry points — any function named exactly ``execute`` or
+  starting with ``_batch_`` in exec/executor.py, cluster/dist.py, or
+  cluster/client.py — must open at least one tracing span
+  (``tracing.start_span(...)``), directly or via a ``with`` block;
+* in a client class that owns the span-injecting transport layer (it
+  defines ``_do_full``, which forwards the active trace context as HTTP
+  headers), public methods must not place transport calls themselves
+  (``urlopen``, ``HTTPConnection``/``HTTPSConnection``,
+  ``self._pool.request``): a direct call skips header injection and
+  deadline propagation, so the remote leg falls out of the trace tree.
+
+Scope is the three hot-path files only; helpers elsewhere may be
+span-free by design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted, walk_no_nested_functions
+from tools.graftlint.engine import Finding
+
+PASS_ID = "span-discipline"
+DESCRIPTION = "execute paths open tracing spans; clients route via _do layer"
+
+_SCOPE_SUFFIXES = ("exec/executor.py", "cluster/dist.py", "cluster/client.py")
+
+_TRANSPORT_SUFFIXES = ("urlopen", "HTTPConnection", "HTTPSConnection")
+
+
+def applies(path: str) -> bool:
+    return path.replace("\\", "/").endswith(_SCOPE_SUFFIXES)
+
+
+def _is_span_entry(fn: ast.FunctionDef) -> bool:
+    return fn.name == "execute" or fn.name.startswith("_batch_")
+
+
+def _opens_span(fn: ast.FunctionDef) -> bool:
+    """True when the function body (nested defs excluded — their spans
+    open in a different dynamic extent) calls ``...start_span(...)``."""
+    for node in walk_no_nested_functions(fn.body):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.rsplit(".", 1)[-1] == "start_span":
+                return True
+    return False
+
+
+def _is_transport_call(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    if d is None:
+        return False
+    if d.rsplit(".", 1)[-1] in _TRANSPORT_SUFFIXES:
+        return True
+    return d.endswith("._pool.request")
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and _is_span_entry(node):
+            if not _opens_span(node):
+                findings.append(
+                    Finding(
+                        path, node.lineno, node.col_offset, PASS_ID,
+                        f"{node.name}() is on the execute path but carries "
+                        "no tracing span: this stretch is invisible to "
+                        "?profile=true and trace export",
+                    )
+                )
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        if not any(m.name == "_do_full" for m in methods):
+            continue
+        for m in methods:
+            if m.name.startswith("_"):
+                continue  # the _do layer itself and private helpers
+            for node in walk_no_nested_functions(m.body):
+                if isinstance(node, ast.Call) and _is_transport_call(node):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, node.col_offset, PASS_ID,
+                            f"{cls.name}.{m.name}() bypasses the "
+                            "span-injecting _do layer with a direct "
+                            "transport call: the remote hop drops out of "
+                            "the trace and ignores the deadline budget",
+                        )
+                    )
+    return findings

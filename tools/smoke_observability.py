@@ -1,0 +1,72 @@
+"""CI smoke check for the observability surface.
+
+Boots one real NodeServer on an auto-bound port, writes a bit, then
+asserts the three operator-visible planes work over actual HTTP:
+
+* ``?profile=true`` returns a populated execution profile next to the
+  query results;
+* ``/metrics`` carries the ``pilosa_kernel_*`` dispatch telemetry;
+* ``/debug/slow-queries`` serves the bounded slow-query log.
+
+Exit status 0 on success; any assertion/exception fails the CI step.
+Run as ``python -m tools.smoke_observability``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def _get(uri: str) -> bytes:
+    return urllib.request.urlopen(uri, timeout=10).read()
+
+
+def _post(uri: str, body: bytes, ctype: str = "text/plain") -> bytes:
+    req = urllib.request.Request(
+        uri, data=body, headers={"Content-Type": ctype}, method="POST"
+    )
+    return urllib.request.urlopen(req, timeout=10).read()
+
+
+def main() -> int:
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(port=0, slow_query_time=0.001)
+    node.start()
+    try:
+        base = node.uri
+        _post(f"{base}/index/smoke", b"{}", "application/json")
+        _post(
+            f"{base}/index/smoke/field/f", b'{"options": {}}', "application/json"
+        )
+        _post(f"{base}/index/smoke/query", b"Set(3, f=1)")
+
+        resp = json.loads(
+            _post(f"{base}/index/smoke/query?profile=true", b"Count(Row(f=1))")
+        )
+        assert resp["results"] == [1], resp
+        prof = resp.get("profile")
+        assert prof, "no profile attached to ?profile=true response"
+        assert prof["tree"]["name"] == "query", prof["tree"]
+        assert prof["tree"].get("children"), "profile tree has no spans"
+        assert prof["duration_ms"] > 0, prof
+
+        metrics = _get(f"{base}/metrics").decode()
+        assert "pilosa_kernel_" in metrics, metrics[:400]
+
+        slow = json.loads(_get(f"{base}/debug/slow-queries"))
+        assert slow["count"] >= 1, slow  # threshold 1ms: queries qualify
+        assert slow["queries"][0]["profile"]["tree"], slow
+
+        vars_ = json.loads(_get(f"{base}/debug/vars"))
+        assert "dispatch_lanes" in vars_.get("kernels", {}), vars_.keys()
+    finally:
+        node.stop()
+    print("observability smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
