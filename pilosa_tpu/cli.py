@@ -16,6 +16,9 @@ Config precedence mirrors the reference (cmd/root.go): flags > env
 
 from __future__ import annotations
 
+# graftlint: disable-file=log-discipline -- CLI subcommands: stdout IS the
+# user interface (CSV export, inspect tables, config emission)
+
 import argparse
 import json
 import os

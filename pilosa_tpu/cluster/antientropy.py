@@ -23,9 +23,10 @@ import time
 import numpy as np
 
 from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import tracing
 
-logger = logging.getLogger("pilosa_tpu.antientropy")
+logger = logging.getLogger(__name__)
 
 
 class HolderSyncer:
@@ -46,8 +47,41 @@ class HolderSyncer:
             "fragments": 0, "blocks_diff": 0, "bits_set": 0,
             "bits_cleared": 0, "attrs_merged": 0, "translate_entries": 0,
         }
+        job = self.holder.jobs.start("antientropy")
         if len(self.cluster.nodes) <= 1:
+            # Single node: the pass is a no-op, but it still counts as a
+            # completed round (the loop ran; there was nothing to repair).
+            self._finish_round(job, stats, time.monotonic())
             return stats
+        t0 = time.monotonic()
+        try:
+            self._sync_holder(stats, job)
+        except Exception as e:
+            job.finish("error", error=f"{type(e).__name__}: {e}")
+            raise
+        self._finish_round(job, stats, t0)
+        return stats
+
+    def _finish_round(self, job, stats: dict, t0: float) -> None:
+        """Round bookkeeping: summary counters into the stats sink
+        (instead of dropping the dict), a journal event, and the job's
+        terminal state."""
+        hstats = self.holder.stats
+        hstats.count("antientropy_rounds", 1)
+        hstats.count(
+            "antientropy_bits_repaired",
+            stats["bits_set"] + stats["bits_cleared"],
+        )
+        hstats.count("antientropy_blocks_merged", stats["blocks_diff"])
+        self.holder.events.record(
+            ev.EVENT_ANTIENTROPY_ROUND,
+            duration=time.monotonic() - t0,
+            job=job.id,
+            **stats,
+        )
+        job.finish("done")
+
+    def _sync_holder(self, stats: dict, job) -> None:
         # span per pass (reference holder.go:683 SyncHolder spans)
         with tracing.start_span("holderSyncer.SyncHolder"):
             # translate-log replication rides the anti-entropy carrier
@@ -65,7 +99,10 @@ class HolderSyncer:
                     logger.warning(
                         "translate-log sync failed", exc_info=True
                     )
+            job.set_phase("schema")
             self.sync_schema()
+            job.set_phase("fragments")
+            job.set_progress(fragments_total=self._count_owned_fragments())
             for index_name in list(self.holder.index_names()):
                 idx = self.holder.index(index_name)
                 if idx is None:
@@ -97,7 +134,32 @@ class HolderSyncer:
                                     index_name, fname, vname, shard, e,
                                 )
                             stats["fragments"] += 1
-        return stats
+                            job.advance(fragments_done=1)
+                            job.set_progress(
+                                bits_repaired=stats["bits_set"]
+                                + stats["bits_cleared"],
+                                blocks_merged=stats["blocks_diff"],
+                            )
+
+    def _count_owned_fragments(self) -> int:
+        """How many fragments this pass will visit (job progress total)."""
+        n = 0
+        for index_name in list(self.holder.index_names()):
+            idx = self.holder.index(index_name)
+            if idx is None:
+                continue
+            for fname in idx.field_names(include_internal=True):
+                field = idx.field(fname)
+                if field is None:
+                    continue
+                for vname in field.view_names():
+                    view = field.view(vname)
+                    for shard in sorted(view.fragments):
+                        if self.cluster.owns_shard(
+                            self.cluster.node_id, index_name, shard
+                        ):
+                            n += 1
+        return n
 
     def sync_schema(self) -> None:
         """Apply the union of all peers' schemas locally (missed

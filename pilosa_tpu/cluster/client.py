@@ -23,6 +23,7 @@ import numpy as np
 
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
+from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import tracing
 from pilosa_tpu.obs.stats import NOP
 from pilosa_tpu.testing import faults
@@ -65,11 +66,13 @@ class CircuitBreaker:
         threshold: int = 5,
         cooldown: float = 2.0,
         stats=NOP,
+        journal=None,
     ):
         self.peer = peer
         self.threshold = max(1, int(threshold))
         self.cooldown = float(cooldown)
         self.stats = stats
+        self.journal = journal  # EventJournal, optional
         self._lock = threading.Lock()
         self._state = BREAKER_CLOSED
         self._failures = 0
@@ -83,11 +86,21 @@ class CircuitBreaker:
 
     def _transition(self, to: str) -> None:
         """Move to ``to`` (lock held) and count the edge."""
+        from_state = self._state
         self._state = to
         self.stats.count_with_tags(
             "circuit_breaker_transitions", 1, 1.0,
             (f"peer:{self.peer}", f"to:{to}"),
         )
+        if self.journal is not None:
+            # EventJournal.record takes its own independent lock and
+            # never calls back into the breaker, so recording under this
+            # lock cannot deadlock.
+            self.journal.record(
+                ev.EVENT_CIRCUIT_BREAKER, peer=self.peer,
+                from_state=from_state, to=to,
+                failures=self._failures,
+            )
 
     def allow(self) -> bool:
         """May a NEW request be routed at this peer right now?  In the
@@ -256,9 +269,11 @@ class InternalClient:
         breaker_threshold: int = 5,
         breaker_cooldown: float = 2.0,
         rng_seed: int | None = None,
+        journal=None,
     ):
         self.timeout = timeout
         self.stats = NOP if stats is None else stats
+        self.journal = journal  # EventJournal; breakers record into it
         # Retry budget: transport failures retry with full-jitter
         # exponential backoff, at most ``retry_budget`` extra attempts
         # per request, never past the remaining deadline, and only for
@@ -302,6 +317,7 @@ class InternalClient:
                     threshold=self.breaker_threshold,
                     cooldown=self.breaker_cooldown,
                     stats=self.stats,
+                    journal=self.journal,
                 )
                 self._breakers[netloc] = br
             return br
@@ -578,6 +594,11 @@ class InternalClient:
         out, _ = self._do_full("GET", uri, "/version", retries=0)
         return json.loads(out) if out else None
 
+    def debug_events(self, uri: str, since: int = 0) -> dict:
+        """Pull a peer's local event journal (coordinator timeline merge
+        fans out through here)."""
+        return self._json("GET", uri, f"/debug/events?since={int(since)}")
+
     def shards_max(self, uri: str) -> dict:
         """Per-index max shard seen by ``uri`` (reference
         client.go:176 MaxShardByIndex)."""
@@ -668,6 +689,9 @@ class NopInternalClient:
 
     def version(self, uri):
         return {}
+
+    def debug_events(self, uri, since=0):
+        return {"events": [], "nextSeq": since, "truncated": False}
 
     def shards_max(self, uri):
         return {}

@@ -96,7 +96,12 @@ class Cluster:
             self.nodes = sorted(nodes, key=lambda n: n.id)
             for n in self.nodes:
                 n.is_coordinator = n.id == self.coordinator_id
+            changed = self.state != STATE_NORMAL
             self.state = STATE_NORMAL
+        # The implicit RESIZING->NORMAL edge of a membership commit must
+        # reach the observer hook like any explicit set_state call.
+        if changed and self.on_state_change is not None:
+            self.on_state_change(STATE_NORMAL)
 
     # -- state machine ------------------------------------------------------
 

@@ -34,8 +34,9 @@ import zlib
 from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.cluster import Cluster, STATE_RESIZING
 from pilosa_tpu.cluster.topology import NODE_STATE_DOWN, NODE_STATE_READY
+from pilosa_tpu.obs import events as ev
 
-logger = logging.getLogger("pilosa_tpu.membership")
+logger = logging.getLogger(__name__)
 
 
 class MembershipMonitor:
@@ -50,10 +51,12 @@ class MembershipMonitor:
         confirm_retries: int = 10,  # reference cluster.go:1702
         confirm_interval: float = 0.1,
         on_change=None,
+        journal=None,
     ):
         self.cluster = cluster
         self.client = client
         self.broadcaster = broadcaster
+        self.journal = journal  # EventJournal, optional
         self.probe_interval = probe_interval
         self.confirm_retries = confirm_retries
         self.confirm_interval = confirm_interval
@@ -125,6 +128,10 @@ class MembershipMonitor:
 
     def _transition(self, node, state: str) -> None:
         logger.info("node %s -> %s", node.id, state)
+        if self.journal is not None:
+            self.journal.record(
+                ev.EVENT_NODE_STATE, peer=node.id, state=state
+            )
         self.cluster.mark_node_state(node.id, state)
         if self.on_change is not None:
             try:

@@ -39,8 +39,9 @@ from pilosa_tpu.cluster.cluster import (
     STATE_RESIZING,
 )
 from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.obs import events as ev
 
-logger = logging.getLogger("pilosa_tpu.resize")
+logger = logging.getLogger(__name__)
 
 
 class ResizeError(Exception):
@@ -88,10 +89,29 @@ class ResizeCoordinator:
         for n in new_nodes:
             all_nodes.setdefault(n.id, n)
 
-        # 1. everyone (old + joining) enters RESIZING.
-        self._send_state_everywhere(all_nodes.values(), STATE_RESIZING)
+        journal = self.api.holder.events
+        job = self.api.holder.jobs.start(
+            "resize",
+            action="remove" if removed else "add",
+            old_nodes=len(old_nodes),
+            new_nodes=len(new_nodes),
+        )
+        journal.record(
+            ev.EVENT_RESIZE_START,
+            action="remove" if removed else "add",
+            old=[n.id for n in old_nodes],
+            new=[n.id for n in new_nodes],
+            removed=removed,
+            job=job.id,
+        )
         try:
+            # 1. everyone (old + joining) enters RESIZING.
+            job.set_phase("broadcast-resizing")
+            journal.record(ev.EVENT_RESIZE_PHASE, phase="broadcast-resizing", job=job.id)
+            self._send_state_everywhere(all_nodes.values(), STATE_RESIZING)
             # 2. inventory: which old member holds which fragments.
+            job.set_phase("inventory")
+            journal.record(ev.EVENT_RESIZE_PHASE, phase="inventory", job=job.id)
             holders = self._gather_inventory(old_nodes, exclude=removed)
             # 3. placement under the new membership.
             new_cluster = Cluster(
@@ -103,6 +123,7 @@ class ResizeCoordinator:
             new_cluster.set_static([Node(id=n.id, uri=n.uri) for n in new_nodes])
             # 4. per new member: fetch instructions for missing fragments.
             old_ids = {n.id for n in old_nodes}
+            plan: list[tuple[Node, list[dict], bool]] = []
             for target in new_nodes:
                 is_joining = target.id not in old_ids
                 instructions = []
@@ -133,13 +154,30 @@ class ResizeCoordinator:
                         }
                     )
                 if instructions or is_joining:
-                    # Joining nodes get the schema first (reference
-                    # followResizeInstruction applies schema before any
-                    # fragment transfer, cluster.go:1304-1323).
-                    self._dispatch_fetch(target, instructions, is_joining)
-        except Exception:
+                    plan.append((target, instructions, is_joining))
+            job.set_phase("migrate")
+            job.set_progress(
+                fragments_total=sum(len(ins) for _, ins, _ in plan)
+            )
+            journal.record(
+                ev.EVENT_RESIZE_PHASE, phase="migrate", job=job.id,
+                targets=len(plan),
+                fragments=sum(len(ins) for _, ins, _ in plan),
+            )
+            for target, instructions, is_joining in plan:
+                # Joining nodes get the schema first (reference
+                # followResizeInstruction applies schema before any
+                # fragment transfer, cluster.go:1304-1323).
+                self._dispatch_fetch(target, instructions, is_joining)
+                job.advance(fragments_done=len(instructions))
+        except Exception as e:
             # Abort: restore old membership + NORMAL on every reachable
             # node (reference ResizeAbort).
+            journal.record(
+                ev.EVENT_RESIZE_ABORT, job=job.id,
+                error=f"{type(e).__name__}: {e}",
+            )
+            job.finish("aborted", error=f"{type(e).__name__}: {e}")
             self._commit_membership(all_nodes.values(), old_nodes)
             raise
         # 5. commit: new membership + NORMAL everywhere, then cleanup.
@@ -153,7 +191,14 @@ class ResizeCoordinator:
             i: {f: sorted(s) for f, s in fields.items()}
             for i, fields in shard_map.items()
         }
+        job.set_phase("commit")
+        journal.record(ev.EVENT_RESIZE_PHASE, phase="commit", job=job.id)
         self._commit_membership(all_nodes.values(), new_nodes, shard_map)
+        journal.record(
+            ev.EVENT_RESIZE_COMMIT, job=job.id,
+            members=[n.id for n in new_nodes],
+        )
+        job.finish("done")
 
     def _send_state_everywhere(self, nodes, state: str) -> None:
         for n in nodes:

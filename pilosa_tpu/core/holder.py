@@ -22,12 +22,21 @@ class Holder:
         self.on_create_index = None
         # Injected metrics sink (reference holder.go Stats, default nop).
         self.stats = stats_mod.NOP
+        # Control-plane observability: cluster event journal + background
+        # job tracker, shared by cluster/storage/server layers the same
+        # way stats is.
+        from pilosa_tpu.obs.events import EventJournal
+        from pilosa_tpu.obs.jobs import JobTracker
+
+        self.events = EventJournal()
+        self.jobs = JobTracker()
 
     def set_stats(self, client: stats_mod.StatsClient) -> None:
         """Install a stats client, re-tagging existing indexes/fields the
         way the reference wires stats at construction (holder.go:112)."""
         with self._lock:
             self.stats = client
+            self.jobs.stats = client
             for name, idx in self.indexes.items():
                 idx.set_stats(client.with_tags(f"index:{name}"))
 

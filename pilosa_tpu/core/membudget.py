@@ -49,6 +49,18 @@ class DeviceBudget:
         with self._lock:
             return len(self._entries)
 
+    def snapshot(self) -> dict:
+        """One consistent view for /metrics and /debug/vars."""
+        with self._lock:
+            return {
+                "usedBytes": self._used,
+                "capBytes": self.cap,
+                "entries": len(self._entries),
+                "evictions": self.evictions,
+                "admissions": self.admissions,
+                "evictErrors": self.evict_errors,
+            }
+
     def would_decline(self, nbytes: int) -> bool:
         """True when a single allocation of ``nbytes`` exceeds the whole
         cap — callers should prefer a paged strategy over admitting it."""

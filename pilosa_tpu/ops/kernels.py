@@ -39,6 +39,7 @@ recount (fragment.go:459-498, 1568-1700).  On TPU those become:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -57,6 +58,8 @@ from pilosa_tpu.compat import shard_map
 from pilosa_tpu.obs import qprofile
 from pilosa_tpu.obs.stats import MemStatsClient
 from pilosa_tpu.ops.bitops import pow2_pad_len
+
+logger = logging.getLogger(__name__)
 
 _OPS = {
     "intersect": lambda a, b: a & b,
@@ -153,9 +156,7 @@ def _note_pallas_fallback(exc: Exception) -> None:
         n = _pallas_fallbacks
     kernel_stats.count("kernel_pallas_fallbacks")
     if n % _PALLAS_FALLBACK_LOG_EVERY == 1:
-        import logging
-
-        logging.getLogger("pilosa_tpu.kernels").warning(
+        logger.warning(
             "pallas kernel demoted to XLA fallback (#%d): %r",
             n,
             exc,
@@ -732,9 +733,7 @@ def _with_gram_fallback(pallas_fn, fallback_fn, gate=None, kernel="gram"):
         if probing:
             # a failing PROBE degrades a default-ON fast path: log each
             # attempt so the resulting latency is diagnosable
-            import logging
-
-            logging.getLogger("pilosa_tpu.kernels").warning(
+            logger.warning(
                 "pallas gram probe failed (%d/%d)%s: %r",
                 gate.fails,
                 gate.MAX_FAILS,

@@ -17,6 +17,7 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu import __version__, deadline
+from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import qprofile
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
@@ -27,7 +28,7 @@ from pilosa_tpu.exec.result import result_to_json
 from pilosa_tpu.storage import roaring
 from pilosa_tpu.storage.disk import HolderStore
 
-logger = logging.getLogger("pilosa_tpu.api")
+logger = logging.getLogger(__name__)
 
 # Cluster states (reference cluster.go:46-51).
 STATE_STARTING = "STARTING"
@@ -112,7 +113,8 @@ class API:
         from pilosa_tpu.server.importpool import ImportPool
 
         self.import_pool = ImportPool(
-            workers=import_workers, depth=import_queue_depth
+            workers=import_workers, depth=import_queue_depth,
+            jobs=self.holder.jobs,
         )
 
     @property
@@ -793,6 +795,115 @@ class API:
                         )
         return out
 
+    # -- control-plane observability (events / jobs / fragments) -----------
+
+    def events_since(self, since: int = 0, limit: int | None = None) -> dict:
+        """This node's local event journal past cursor ``since``."""
+        return self.holder.events.since(since, limit)
+
+    def cluster_events(self, since: int = 0) -> dict:
+        """Cluster timeline: fan out to every peer's LOCAL journal and
+        merge into one time-ordered view (coordinator view; any node can
+        serve it).  Unreachable peers are reported, not fatal —
+        a partitioned peer's missing events should read as "missing",
+        the same contract as a truncated cursor."""
+        local = self.holder.events.since(since)
+        per_node = [local["events"]]
+        unreachable = []
+        if self.cluster is not None and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.node_id or not node.uri:
+                    continue
+                try:
+                    remote = self.client.debug_events(node.uri, since)
+                except Exception as e:
+                    unreachable.append({"node": node.id, "error": str(e)})
+                    continue
+                per_node.append(remote.get("events", []))
+        merged = ev.merge_timelines(per_node)
+        return {
+            "events": merged,
+            "nodes": len(per_node),
+            "unreachable": unreachable,
+        }
+
+    def jobs_snapshot(self, kind: str | None = None) -> dict:
+        """Background-job records (active + bounded history)."""
+        return self.holder.jobs.snapshot(kind)
+
+    def fragment_details(
+        self, index: str | None = None, field: str | None = None
+    ) -> dict:
+        """Per-fragment storage/residency introspection plus a
+        holder-level aggregate and the device budget block
+        (/debug/fragments)."""
+        from pilosa_tpu.core import membudget
+
+        fragments = []
+        now = time.time()
+        for iname in self.holder.index_names():
+            if index is not None and iname != index:
+                continue
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for fname in idx.field_names(include_internal=True):
+                if field is not None and fname != field:
+                    continue
+                fld = idx.field(fname)
+                if fld is None:
+                    continue
+                for vname in fld.view_names():
+                    view = fld.view(vname)
+                    for shard in sorted(view.fragments):
+                        frag = view.fragments[shard]
+                        with frag._lock:
+                            rows = len(frag._slot_of)
+                            host_bytes = frag._host.nbytes
+                            device_resident = frag._device is not None
+                            device_bytes = (
+                                frag._device_nbytes() if device_resident else 0
+                            )
+                            counts_cached = frag._counts is not None
+                            op_n = frag.op_n
+                            mut_version = frag.version
+                        store = frag.store
+                        last_snap = getattr(store, "last_snapshot_at", None)
+                        d = {
+                            "index": iname,
+                            "field": fname,
+                            "view": vname,
+                            "shard": shard,
+                            "rows": rows,
+                            "bits": frag.total_count(),
+                            "containers": roaring.container_stats(
+                                frag.all_positions()
+                            ),
+                            "hostBytes": host_bytes,
+                            "deviceResident": device_resident,
+                            "deviceBytes": device_bytes,
+                            "countsCached": counts_cached,
+                            "opLogLength": op_n,
+                            "version": mut_version,
+                            "lastSnapshotAge": (
+                                now - last_snap if last_snap else None
+                            ),
+                        }
+                        fragments.append(d)
+        totals = {
+            "fragments": len(fragments),
+            "bits": sum(f["bits"] for f in fragments),
+            "hostBytes": sum(f["hostBytes"] for f in fragments),
+            "deviceResident": sum(1 for f in fragments if f["deviceResident"]),
+            "deviceBytes": sum(f["deviceBytes"] for f in fragments),
+            "opLogLength": sum(f["opLogLength"] for f in fragments),
+        }
+        return {
+            "fragments": fragments,
+            "totals": totals,
+            "device": membudget.default_budget().snapshot(),
+        }
+
     def resize_fetch(self, req: dict) -> dict:
         """Fetch and install the listed fragments from their source nodes
         (reference followResizeInstruction cluster.go:1272-1381). Runs
@@ -805,21 +916,31 @@ class API:
             # (reference cluster.go:1304-1323).
             self.holder.apply_schema(req["schema"])
             self._sync()
+        instructions = req.get("instructions", [])
+        job = self.holder.jobs.start("resize-fetch")
+        job.set_phase("fetch")
+        job.set_progress(fragments_total=len(instructions))
         fetched = 0
-        for ins in req.get("instructions", []):
-            index, fname = ins["index"], ins["field"]
-            f = self.holder.field(index, fname)
-            if f is None:
-                raise ApiError(
-                    f"resize target missing schema for {index}/{fname}", 500
+        try:
+            for ins in instructions:
+                index, fname = ins["index"], ins["field"]
+                f = self.holder.field(index, fname)
+                if f is None:
+                    raise ApiError(
+                        f"resize target missing schema for {index}/{fname}", 500
+                    )
+                data = self.client.retrieve_fragment(
+                    ins["sourceURI"], index, fname, ins["view"], int(ins["shard"])
                 )
-            data = self.client.retrieve_fragment(
-                ins["sourceURI"], index, fname, ins["view"], int(ins["shard"])
-            )
-            self._apply_roaring(
-                index, f, int(ins["shard"]), data, False, ins["view"]
-            )
-            fetched += 1
+                self._apply_roaring(
+                    index, f, int(ins["shard"]), data, False, ins["view"]
+                )
+                fetched += 1
+                job.advance(fragments_done=1, bytes_moved=len(data))
+        except Exception as e:
+            job.finish("aborted", error=f"{type(e).__name__}: {e}")
+            raise
+        job.finish("done")
         return {"fetched": fetched}
 
     def _clean_unowned_fragments(self) -> int:
@@ -904,6 +1025,12 @@ class API:
                     if msg.get("coordinator"):
                         self.cluster.coordinator_id = msg["coordinator"]
                     self.cluster.disabled = False
+                    old_ids = {n.id for n in self.cluster.nodes}
+                    new_ids = {n["id"] for n in nodes}
+                    for nid in sorted(new_ids - old_ids):
+                        self.holder.events.record(ev.EVENT_NODE_JOIN, peer=nid)
+                    for nid in sorted(old_ids - new_ids):
+                        self.holder.events.record(ev.EVENT_NODE_LEAVE, peer=nid)
                     self.cluster.set_static(
                         [CNode(id=n["id"], uri=n.get("uri", "")) for n in nodes]
                     )

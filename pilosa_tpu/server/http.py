@@ -38,7 +38,7 @@ from pilosa_tpu.deadline import DeadlineExceeded
 from pilosa_tpu.obs import tracing
 from pilosa_tpu.server.api import API, ApiError
 
-logger = logging.getLogger("pilosa_tpu.http")
+logger = logging.getLogger(__name__)
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/$"), "root"),
@@ -53,6 +53,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
     ("GET", re.compile(r"^/debug/memory$"), "debug_memory"),
+    ("GET", re.compile(r"^/debug/events$"), "debug_events"),
+    ("GET", re.compile(r"^/debug/jobs$"), "debug_jobs"),
+    ("GET", re.compile(r"^/debug/fragments$"), "debug_fragments"),
     ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),  # graftlint: disable=dispatch-parity -- operator debug endpoint (curl/monitoring), never called node-to-node
     ("GET", re.compile(r"^/export$"), "export"),
     ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), "query"),
@@ -226,9 +229,19 @@ class Handler(BaseHTTPRequestHandler):
         registry (ops/kernels.kernel_stats) so it is visible even when
         the holder uses a NopStatsClient; both registries are rendered
         into the one scrape."""
+        from pilosa_tpu.core import membudget
         from pilosa_tpu.obs.stats import prometheus_text
         from pilosa_tpu.ops import kernels
 
+        # Device-budget occupancy refreshes at scrape time — gauges, not
+        # counters, so no background poller is needed.
+        stats = self.api.holder.stats
+        if hasattr(stats, "gauge"):
+            dev = membudget.default_budget().snapshot()
+            stats.gauge("device_used_bytes", dev["usedBytes"])
+            stats.gauge("device_cap_bytes", dev["capBytes"] or 0)
+            stats.gauge("device_entries", dev["entries"])
+            stats.gauge("device_evictions", dev["evictions"])
         text = prometheus_text(self.api.holder.stats) + prometheus_text(
             kernels.kernel_stats
         )
@@ -255,10 +268,44 @@ class Handler(BaseHTTPRequestHandler):
                 "stack_incremental": ex.stack_incremental,
                 "bsi_stack_launches": ex.bsi_stack_launches,
             }
+        from pilosa_tpu.core import membudget
         from pilosa_tpu.ops import kernels
 
         snap["kernels"] = kernels.telemetry_snapshot()
+        snap["device"] = membudget.default_budget().snapshot()
+        snap["events"] = self.api.holder.events.snapshot_summary()
         self._send_json(200, snap)
+
+    def r_debug_events(self):
+        """Event journal past ?since=<seq> (gap-free cursor resume);
+        ?cluster=true fans out to every peer and merges the journals
+        into one cluster timeline."""
+        try:
+            since = int(self.query_params.get("since", ["0"])[0])
+            limit_raw = self.query_params.get("limit", [None])[0]
+            limit = int(limit_raw) if limit_raw is not None else None
+        except ValueError:
+            self._send_json(400, {"error": "bad since/limit"})
+            return
+        if self.query_params.get("cluster", ["false"])[0].lower() in (
+            "1", "true", "yes",
+        ):
+            self._send_json(200, self.api.cluster_events(since))
+            return
+        self._send_json(200, self.api.events_since(since, limit))
+
+    def r_debug_jobs(self):
+        """Background-job records: active + bounded history, with phase,
+        progress counters, rates and ETA (?kind= filters)."""
+        kind = self.query_params.get("kind", [None])[0]
+        self._send_json(200, self.api.jobs_snapshot(kind))
+
+    def r_debug_fragments(self):
+        """Per-fragment storage/residency introspection
+        (?index=&field= filter)."""
+        index = self.query_params.get("index", [None])[0]
+        field = self.query_params.get("field", [None])[0]
+        self._send_json(200, self.api.fragment_details(index, field))
 
     def r_debug_slow_queries(self):
         """Bounded worst-offender log of queries over the server's

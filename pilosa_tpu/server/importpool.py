@@ -18,18 +18,49 @@ import threading
 
 
 class ImportPool:
-    def __init__(self, workers: int = 2, depth: int = 16):
+    def __init__(self, workers: int = 2, depth: int = 16, jobs=None):
         # depth <= 0 would make the queue unbounded, silently removing
         # the backpressure this pool exists to provide
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._local = threading.local()
         self._closed = False
+        # Drain tracking: one "import-drain" job spans each busy period
+        # (first submission after idle -> last completion), so a bulk
+        # ingest shows up as a single progressing job at /debug/jobs.
+        self._jobs = jobs  # JobTracker, optional
+        self._drain_lock = threading.Lock()
+        self._inflight = 0
+        self._drain_job = None
         self._threads = [
             threading.Thread(target=self._worker, daemon=True, name=f"import-{i}")
             for i in range(max(1, workers))
         ]
         for t in self._threads:
             t.start()
+
+    # -- drain-job bookkeeping ----------------------------------------------
+
+    def _drain_begin(self) -> None:
+        if self._jobs is None:
+            return
+        with self._drain_lock:
+            self._inflight += 1
+            if self._drain_job is None:
+                self._drain_job = self._jobs.start("import-drain")
+                self._drain_job.set_phase("draining")
+
+    def _drain_end(self, failed: bool) -> None:
+        if self._jobs is None:
+            return
+        with self._drain_lock:
+            self._inflight -= 1
+            job = self._drain_job
+            if job is None:
+                return
+            job.advance(imports_done=1, errors=1 if failed else 0)
+            if self._inflight == 0:
+                job.finish("done")
+                self._drain_job = None
 
     def _worker(self) -> None:
         self._local.is_worker = True
@@ -51,14 +82,24 @@ class ImportPool:
         for queue space (backpressure) and for completion, like the
         reference handler blocking on the job's error channel
         (api.go:330-346)."""
-        if self._closed or getattr(self._local, "is_worker", False):
-            return fn()
-        done = {"event": threading.Event()}
-        self._q.put((fn, done))
-        done["event"].wait()
-        if "error" in done:
-            raise done["error"]
-        return done["result"]
+        self._drain_begin()
+        failed = False
+        try:
+            if self._closed or getattr(self._local, "is_worker", False):
+                try:
+                    return fn()
+                except BaseException:
+                    failed = True
+                    raise
+            done = {"event": threading.Event()}
+            self._q.put((fn, done))
+            done["event"].wait()
+            if "error" in done:
+                failed = True
+                raise done["error"]
+            return done["result"]
+        finally:
+            self._drain_end(failed)
 
     def close(self) -> None:
         self._closed = True

@@ -21,10 +21,11 @@ from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.cluster import Cluster
 from pilosa_tpu.cluster.topology import Node
 from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.obs import events as ev
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.http import Server
 
-logger = logging.getLogger("pilosa_tpu.node")
+logger = logging.getLogger(__name__)
 from pilosa_tpu.shardwidth import SHARD_WORDS
 from pilosa_tpu.storage.disk import HolderStore
 
@@ -69,7 +70,18 @@ class NodeServer:
             self.store = HolderStore(self.holder, data_dir)
             self.store.open()
         node_id = self.store.node_id() if self.store else uuid.uuid4().hex
+        # Event journal / job tracker carry this node's id on every
+        # record (the cluster timeline merge keys on it).
+        self.holder.events.node_id = node_id
+        self.holder.jobs.node_id = node_id
         self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
+        # Every cluster-state transition — local or applied from a peer's
+        # broadcast — lands on the timeline.
+        self.cluster.on_state_change = (
+            lambda state: self.holder.events.record(
+                ev.EVENT_CLUSTER_STATE, state=state
+            )
+        )
         self.client = InternalClient(
             timeout=client_timeout,
             skip_verify=tls_skip_verify,
@@ -80,6 +92,7 @@ class NodeServer:
             breaker_cooldown=breaker_cooldown,
             # Deterministic jitter per node (chaos tests rely on replay).
             rng_seed=zlib.crc32(node_id.encode()),
+            journal=self.holder.events,
         )
         self.broadcaster = HTTPBroadcaster(self.cluster, self.client, node_id)
         self.api = API(
@@ -197,6 +210,9 @@ class NodeServer:
         self.server.serve_background()
         self.cluster.local_node.uri = self.uri
         self.runtime_monitor.start()
+        self.holder.events.record(
+            ev.EVENT_NODE_START, uri=self.uri, state=self.api.state
+        )
 
     def start_anti_entropy(self, interval: float) -> None:
         """Background anti-entropy loop (reference server.go:494-546
@@ -261,6 +277,11 @@ class NodeServer:
         self.cluster.coordinator_id = coordinator_id
         self.cluster.disabled = False
         self.cluster.set_static([Node(id=i, uri=u) for i, u in members])
+        self.holder.events.record(
+            ev.EVENT_MEMBERSHIP_SET,
+            members=[i for i, _ in members],
+            coordinator=coordinator_id,
+        )
         if coordinator_id == self.cluster.node_id:
             return
         coord = next(
@@ -301,6 +322,7 @@ class NodeServer:
                 probe_interval=probe_interval,
                 confirm_retries=confirm_retries,
                 confirm_interval=confirm_interval,
+                journal=self.holder.events,
             )
             self.membership.start()
         return self.membership

@@ -164,7 +164,10 @@ class HolderStore:
                 return
             path = self._fragment_path(idx.name, field.name, view.name, shard)
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            store = FragmentFile(frag, path, self.snapshot_queue)
+            store = FragmentFile(
+                frag, path, self.snapshot_queue,
+                journal=self.holder.events,
+            )
             store.open()
             self._stores.append(store)
 

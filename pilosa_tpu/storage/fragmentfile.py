@@ -10,16 +10,21 @@ the fragment's host mirror.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
+import time
 
 import numpy as np
 
 from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.obs import events as ev
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.storage import roaring
 from pilosa_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
 
 # reference fragment.go:84.
 MAX_OP_N = 10000
@@ -35,10 +40,18 @@ _BATCH_CHUNK = 65536
 class FragmentFile:
     """Owns the on-disk file of one fragment."""
 
-    def __init__(self, fragment: Fragment, path: str, snapshot_queue: "SnapshotQueue | None" = None):
+    def __init__(
+        self,
+        fragment: Fragment,
+        path: str,
+        snapshot_queue: "SnapshotQueue | None" = None,
+        journal=None,
+    ):
         self.fragment = fragment
         self.path = path
         self.snapshot_queue = snapshot_queue
+        self.journal = journal  # EventJournal; snapshot compactions record
+        self.last_snapshot_at: float | None = None
         self._lock = threading.Lock()
         self._fh = None
         self._closed = False
@@ -344,7 +357,18 @@ class FragmentFile:
             self._fh.close()
         os.replace(tmp, self.path)
         self._fh = open(self.path, "ab")
+        ops_compacted = self.op_n
         self.op_n = 0
+        self.last_snapshot_at = time.time()
+        if self.journal is not None:
+            frag = self.fragment
+            self.journal.record(
+                ev.EVENT_SNAPSHOT,
+                path=self.path,
+                bytes=len(data),
+                ops_compacted=ops_compacted,
+                shard=getattr(frag, "shard", None),
+            )
 
     def _encode_rows(self, rids: np.ndarray, rwords: np.ndarray) -> bytes:
         """Snapshot bytes for ascending row ids + stacked words: the
@@ -400,8 +424,6 @@ class SnapshotQueue:
             store.snapshot()
 
     def _run(self) -> None:
-        import logging
-
         while True:
             store = self._queue.get()
             if store is None:
@@ -411,9 +433,7 @@ class SnapshotQueue:
             except Exception:
                 # e.g. the fragment's directory was deleted mid-flight;
                 # never let a failed snapshot kill the worker
-                logging.getLogger("pilosa_tpu.storage").exception(
-                    "snapshot failed for %s", store.path
-                )
+                logger.exception("snapshot failed for %s", store.path)
             finally:
                 with self._lock:
                     self._pending.discard(id(store))

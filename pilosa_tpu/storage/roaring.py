@@ -164,6 +164,44 @@ def _serialize_py(positions: np.ndarray, flags: int = 0) -> bytes:
     return bytes(out)
 
 
+def container_stats(positions: np.ndarray) -> dict:
+    """Per-container-type counts for sorted uint64 positions, using the
+    same array/run/bitmap selection rules as :func:`serialize` — the
+    introspection view (/debug/fragments) reports what the codec would
+    actually write, without encoding anything."""
+    positions = np.asarray(positions, dtype=np.uint64)
+    if positions.size and np.any(positions[1:] <= positions[:-1]):
+        positions = np.unique(positions)
+    counts = {"array": 0, "run": 0, "bitmap": 0}
+    keys = positions >> np.uint64(16)
+    lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
+    ukeys, starts = np.unique(keys, return_index=True)
+    bounds = np.append(starts, len(positions))
+    for i in range(len(ukeys)):
+        vals = lows[bounds[i] : bounds[i + 1]]
+        n = len(vals)
+        if n:
+            breaks = np.flatnonzero(np.diff(vals.astype(np.int64)) != 1)
+            run_count = len(breaks) + 1
+        else:
+            run_count = 0
+        best = min(
+            (2 * n if n <= ARRAY_MAX_SIZE else 1 << 30, CONTAINER_ARRAY),
+            (2 + 4 * run_count if run_count <= RUN_MAX_SIZE else 1 << 30,
+             CONTAINER_RUN),
+            (8192, CONTAINER_BITMAP),
+            key=lambda t: t[0],
+        )
+        if best[1] == CONTAINER_ARRAY:
+            counts["array"] += 1
+        elif best[1] == CONTAINER_RUN:
+            counts["run"] += 1
+        else:
+            counts["bitmap"] += 1
+    counts["containers"] = len(ukeys)
+    return counts
+
+
 # ---------------------------------------------------------------------------
 # Deserialization
 # ---------------------------------------------------------------------------

@@ -142,9 +142,18 @@ class InProcessCluster:
 
     def fault_registry(self, seed: int = 0) -> faults.FaultRegistry:
         """The cluster's installed fault registry (created + installed
-        lazily; ``seed`` only applies to the first call)."""
+        lazily; ``seed`` only applies to the first call).  Every rule
+        firing is journaled on the coordinator so chaos runs read as one
+        timeline: fault fired -> breaker opened -> job aborted."""
         if self._faults is None:
             self._faults = faults.install(faults.FaultRegistry(seed=seed))
+            from pilosa_tpu.obs import events as ev
+
+            journal = self.nodes[0].holder.events if self.nodes else None
+            if journal is not None:
+                self._faults.on_fire = lambda kind, target: journal.record(
+                    ev.EVENT_FAULT_INJECTED, kind=kind, target=target
+                )
         return self._faults
 
     def inject_fault(

@@ -86,6 +86,10 @@ class FaultRegistry:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._faults: list[Fault] = []
+        # Observer called OUTSIDE the registry lock after a rule fires:
+        # fn(kind, target) — the test cluster wires this into the event
+        # journal so injected faults appear on the cluster timeline.
+        self.on_fire = None
 
     def add(self, kind: str, **kw) -> Fault:
         fault = Fault(kind, **kw)
@@ -133,6 +137,7 @@ class FaultRegistry:
                     break
         if fired is None:
             return None
+        self._notify(fired, f"{netloc}{route}")
         if fired.kind == "reset":
             raise ConnectionResetError(
                 f"fault-injected connection reset ({netloc}{route})"
@@ -162,7 +167,19 @@ class FaultRegistry:
                     fired = fault
                     break
         if fired is not None:
+            self._notify(fired, path)
             raise OSError(f"fault-injected disk write failure: {path}")
+
+    def _notify(self, fault: Fault, target: str) -> None:
+        """Invoke the observer (no lock held); observer bugs never mask
+        the fault being injected."""
+        cb = self.on_fire
+        if cb is None:
+            return
+        try:
+            cb(fault.kind, target)
+        except Exception:  # graftlint: disable=exception-hygiene -- observer is best-effort; a journal bug must not mask the injected fault
+            pass
 
 
 # -- global hook points ------------------------------------------------------

@@ -23,6 +23,7 @@ PER_FILE = [
     "exception_hygiene",
     "timeout_discipline",
     "span_discipline",
+    "log_discipline",
 ]
 
 
@@ -94,6 +95,12 @@ class TestBadCorpusCoverage:
         msgs = " | ".join(self._msgs("span_discipline"))
         assert "no tracing span" in msgs
         assert "bypasses the span-injecting" in msgs
+
+    def test_log_classes(self):
+        msgs = " | ".join(self._msgs("log_discipline"))
+        assert "print() bypasses" in msgs
+        assert "must take __name__" in msgs
+        assert "inside a function" in msgs
 
 
 class TestDispatchParity:

@@ -1,0 +1,251 @@
+"""Control-plane observability: event-journal cursor semantics
+(wraparound, gap-free ``?since=`` resume), background-job tracking to a
+terminal status, and the /debug/{events,jobs,fragments} HTTP surface on
+a live cluster — including the issue's acceptance scenario: ``add_node``
+produces a journaled start -> phases -> commit sequence plus a job whose
+progress runs monotonically to ``done``, and a fault injected mid-resize
+leaves a terminal ``aborted`` job with the error attached."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.obs import events as ev
+from pilosa_tpu.obs.events import EventJournal, merge_timelines
+from pilosa_tpu.obs.jobs import JobTracker
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+
+def _get(uri, path):
+    return json.load(urllib.request.urlopen(uri + path, timeout=10))
+
+
+# -- event journal unit tests -------------------------------------------------
+
+
+def test_journal_seqs_start_at_one_and_never_repeat():
+    j = EventJournal(capacity=8, node_id="n0")
+    a = j.record(ev.EVENT_NODE_START, uri="x")
+    b = j.record(ev.EVENT_CLUSTER_STATE, state="NORMAL")
+    assert (a["seq"], b["seq"]) == (1, 2)
+    assert a["node"] == "n0" and a["data"] == {"uri": "x"}
+    assert j.last_seq == 2
+
+
+def test_empty_journal_since():
+    out = EventJournal().since(0)
+    assert out["events"] == []
+    assert out["nextSeq"] == 0
+    assert out["truncated"] is False
+
+
+def test_cursor_poll_loop_is_gap_and_duplicate_free():
+    j = EventJournal(capacity=64)
+    for i in range(10):
+        j.record("t", i=i)
+    seen, cursor = [], 0
+    while True:
+        out = j.since(cursor, limit=3)
+        if not out["events"]:
+            break
+        assert out["truncated"] is False
+        seen.extend(e["seq"] for e in out["events"])
+        cursor = out["nextSeq"]
+    assert seen == list(range(1, 11))
+    # a fully caught-up cursor stays put
+    out = j.since(cursor)
+    assert out["events"] == [] and out["nextSeq"] == cursor
+
+
+def test_wraparound_reports_truncation_instead_of_silent_gap():
+    j = EventJournal(capacity=4)
+    for i in range(10):
+        j.record("t", i=i)
+    assert j.dropped == 6
+    # stale cursor: events 1..6 were evicted under it
+    out = j.since(2)
+    assert [e["seq"] for e in out["events"]] == [7, 8, 9, 10]
+    assert out["truncated"] is True
+    assert out["firstSeq"] == 7 and out["lastSeq"] == 10
+    # a cursor at the eviction edge has missed nothing
+    out = j.since(6)
+    assert [e["seq"] for e in out["events"]] == [7, 8, 9, 10]
+    assert out["truncated"] is False
+    # cursor past everything the ring ever held
+    out = j.since(10)
+    assert out["events"] == [] and out["truncated"] is False
+    assert out["nextSeq"] == 10
+
+
+def test_cursor_entirely_evicted_fast_forwards():
+    j = EventJournal(capacity=2)
+    for i in range(10):
+        j.record("t", i=i)
+    out = j.since(3, limit=0)
+    # limit=0 delivers nothing but still fast-forwards past the hole
+    assert out["events"] == []
+    assert out["truncated"] is True
+
+
+def test_merge_timelines_orders_by_time_then_node_then_seq():
+    a = [{"seq": 1, "ts": 2.0, "node": "a"}, {"seq": 2, "ts": 5.0, "node": "a"}]
+    b = [{"seq": 1, "ts": 2.0, "node": "b"}, {"seq": 2, "ts": 1.0, "node": "b"}]
+    merged = merge_timelines([a, b])
+    assert [(e["node"], e["seq"]) for e in merged] == [
+        ("b", 2), ("a", 1), ("b", 1), ("a", 2),
+    ]
+
+
+# -- job tracker unit tests ---------------------------------------------------
+
+
+def test_job_progress_percent_eta_and_terminal_done():
+    t = JobTracker()
+    job = t.start("resize", action="add")
+    job.set_phase("migrate")
+    job.set_progress(fragments_total=4)
+    job.advance(fragments_done=1)
+    job.advance(fragments_done=1, bytes_moved=4096)
+    snap = job.snapshot()
+    assert snap["status"] == "running"
+    assert snap["phase"] == "migrate"
+    assert snap["percent"] == 50.0
+    assert snap["eta_seconds"] > 0
+    assert snap["rates"]["fragments_done_per_sec"] > 0
+    assert "fragments_total_per_sec" not in snap["rates"]
+    job.finish("done")
+    out = t.snapshot()
+    assert out["active"] == 0
+    [done] = out["jobs"]
+    assert done["status"] == "done" and done["finished"] is not None
+    assert done["meta"] == {"action": "add"}
+
+
+def test_job_counters_are_monotonic_and_terminal_is_final():
+    t = JobTracker()
+    job = t.start("antientropy")
+    job.advance(bits=-5)             # negative deltas ignored
+    job.set_progress(bits=10)
+    job.set_progress(bits=3)         # smaller absolute value ignored
+    assert job.snapshot()["progress"] == {"bits": 10}
+    job.finish("aborted", error="boom")
+    job.finish("done")               # terminal is final
+    job.advance(bits=99)             # mutation after terminal ignored
+    snap = job.snapshot()
+    assert snap["status"] == "aborted"
+    assert snap["error"] == "boom"
+    assert snap["progress"] == {"bits": 10}
+
+
+def test_tracker_snapshot_filters_by_kind_newest_first():
+    t = JobTracker()
+    t.start("resize").finish("done")
+    t.start("antientropy")
+    t.start("resize")
+    out = t.snapshot(kind="resize")
+    assert [j["id"] for j in out["jobs"]] == [3, 1]
+    assert out["active"] == 1
+
+
+# -- live-cluster acceptance (issue: journaled resize + tracked jobs) --------
+
+
+def test_add_node_is_journaled_and_job_runs_to_done():
+    with InProcessCluster(2, with_disk=True) as c:
+        c.create_index("oi")
+        c.create_field("oi", "of")
+        c.import_bits("oi", "of", [(1, s * SHARD_WIDTH + 3) for s in range(6)])
+        coord = c.coordinator
+        cursor = coord.holder.events.last_seq
+        c.sync_all()  # tracked antientropy round on every node
+        c.add_node()
+
+        out = _get(coord.uri, f"/debug/events?since={cursor}")
+        assert out["truncated"] is False
+        types = [e["type"] for e in out["events"]]
+        # start -> phases (in protocol order) -> commit, then the join
+        assert types.index("resize-start") < types.index("resize-commit")
+        phases = [
+            e["data"]["phase"] for e in out["events"]
+            if e["type"] == "resize-phase"
+        ]
+        assert phases == ["broadcast-resizing", "inventory", "migrate", "commit"]
+        assert "node-join" in types
+        assert "antientropy-round" in types
+        # cursor resume from nextSeq: no duplicates, no gap
+        again = _get(coord.uri, f"/debug/events?since={out['nextSeq']}")
+        assert again["events"] == [] and again["truncated"] is False
+
+        jobs = _get(coord.uri, "/debug/jobs?kind=resize")
+        [job] = [j for j in jobs["jobs"] if j["status"] == "done"]
+        prog = job["progress"]
+        assert prog["fragments_done"] == prog["fragments_total"] > 0
+        assert job["percent"] == 100.0
+        assert job["error"] is None
+        done_kinds = {
+            j["kind"]
+            for j in _get(coord.uri, "/debug/jobs")["jobs"]
+            if j["status"] == "done"
+        }
+        assert {"resize", "antientropy", "import-drain"} <= done_kinds
+
+        # merged cluster timeline carries every peer's origin
+        merged = _get(coord.uri, "/debug/events?cluster=true")
+        assert merged["unreachable"] == []
+        origins = {e["node"] for e in merged["events"]}
+        assert origins == {n.node_id for n in c.nodes}
+
+        # fragment introspection sees the data (ownership is spread by
+        # jump hash, so assert cluster-wide and check shape per node)
+        total = 0
+        for n in c.nodes:
+            frags = _get(n.uri, "/debug/fragments?index=oi")
+            assert frags["totals"]["fragments"] == len(frags["fragments"])
+            assert all(f["index"] == "oi" for f in frags["fragments"])
+            assert "usedBytes" in frags["device"]
+            total += frags["totals"]["fragments"]
+        assert total > 0
+
+        # satellite: job/device/antientropy series reach /metrics
+        with urllib.request.urlopen(coord.uri + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "pilosa_job_started" in text
+        assert "pilosa_job_finished" in text
+        assert "pilosa_device_used_bytes" in text
+        assert "pilosa_antientropy_rounds" in text
+
+
+def test_fault_mid_resize_leaves_terminal_aborted_job():
+    with InProcessCluster(2) as c:
+        c.create_index("fi")
+        c.create_field("fi", "ff")
+        c.import_bits("fi", "ff", [(1, s * SHARD_WIDTH) for s in range(4)])
+        coord = c.coordinator
+        # kill the inventory fetch so the resize dies mid-flight
+        c.inject_fault("reset", node=1, route="/internal/fragments")
+        try:
+            with pytest.raises(Exception):
+                c.add_node()
+        finally:
+            c.clear_faults()
+
+        jobs = _get(coord.uri, "/debug/jobs?kind=resize")
+        [job] = jobs["jobs"]
+        assert job["status"] == "aborted"
+        assert job["error"] and "inventory" in job["error"]
+        assert job["finished"] is not None
+
+        out = _get(coord.uri, "/debug/events")
+        types = [e["type"] for e in out["events"]]
+        assert "resize-abort" in types
+        assert "fault-injected" in types
+        abort = next(e for e in out["events"] if e["type"] == "resize-abort")
+        assert abort["data"]["job"] == job["id"]
+
+        # the abort restored the old membership + NORMAL
+        assert coord.api.state == "NORMAL"
+        assert len(coord.cluster.nodes) == 2
+        got = coord.api.query("fi", "Count(Row(ff=1))")["results"][0]
+        assert got == 4

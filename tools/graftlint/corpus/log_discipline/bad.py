@@ -1,0 +1,19 @@
+"""log-discipline bad corpus."""
+
+import logging
+
+# hard-coded logger name drifts from the module layout on rename
+logger = logging.getLogger("pilosa_tpu.storage")
+
+# bare getLogger() grabs the root logger
+root = logging.getLogger()
+
+
+def report(count):
+    print(f"processed {count} records")  # bypasses logging config
+
+
+def lazy_log(msg):
+    # function-level getLogger: re-resolved per call, invisible to
+    # import-time configuration
+    logging.getLogger(__name__).warning(msg)

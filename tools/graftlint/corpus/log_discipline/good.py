@@ -1,0 +1,18 @@
+"""log-discipline good corpus."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Worker:
+    # class-level logger attribute: created once at import, fine
+    log = logging.getLogger(__name__)
+
+    def run(self, count):
+        logger.info("processed %d records", count)
+        self.log.debug("done")
+
+
+def report(count):
+    logger.warning("processed %d records", count)

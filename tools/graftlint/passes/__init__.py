@@ -7,6 +7,7 @@ from tools.graftlint.passes import (
     durability,
     exception_hygiene,
     lock_discipline,
+    log_discipline,
     span_discipline,
     timeout_discipline,
     tpu_purity,
@@ -21,6 +22,7 @@ ALL_PASSES = [
     timeout_discipline,
     span_discipline,
     dispatch_parity,
+    log_discipline,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

@@ -1,0 +1,109 @@
+"""log-discipline: structured logging only, wired through module loggers.
+
+Invariant: everything ``pilosa_tpu/`` emits goes through ``logging``
+with the standard module-level logger idiom, so operators can configure
+levels/handlers per subsystem by module path:
+
+* no ``print()`` — a server library writing to stdout bypasses every
+  handler, formatter, and level the embedder configured (and corrupts
+  protocols that own stdout, like the CLI's CSV export);
+* ``logging.getLogger(...)`` takes ``__name__`` — hard-coded logger
+  names (``"pilosa_tpu.storage"``) drift from the module layout, so a
+  per-module level filter silently stops matching after a rename;
+* ``getLogger`` calls live at module scope — a logger created inside a
+  function hides from "configure before first use" setups and re-runs
+  the registry lookup per call.
+
+Scope: ``pilosa_tpu/`` only.  Tests and tools print freely (pytest owns
+their stdout); the CLI's user-facing output is suppressed file-wide at
+the call sites that ARE the UI.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "log-discipline"
+DESCRIPTION = "pilosa_tpu/: no print(); logging.getLogger(__name__) at module level only"
+
+
+def applies(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "pilosa_tpu/" in p
+
+
+def _is_module_level(node: ast.AST, module_level: set[int]) -> bool:
+    return id(node) in module_level
+
+
+def _collect_module_level_calls(tree: ast.AST) -> set[int]:
+    """ids of Call nodes whose enclosing scope is the module body (walks
+    statements but does not descend into function/class-method bodies —
+    class-level logger attributes count as module scope for our
+    purposes, since they are created once at import)."""
+    out: set[int] = set()
+
+    def visit_stmts(stmts):
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                visit_stmts(stmt.body)
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    out.add(id(node))
+
+    visit_stmts(getattr(tree, "body", []))
+    return out
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    module_level = _collect_module_level_calls(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name == "print":
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    "print() bypasses the logging configuration (levels, "
+                    "handlers, formatting); use a module logger",
+                )
+            )
+            continue
+        if name is None or name.rsplit(".", 1)[-1] != "getLogger":
+            continue
+        if not _is_module_level(node, module_level):
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    "getLogger() inside a function re-resolves the logger "
+                    "per call and hides it from import-time configuration; "
+                    "hoist to a module-level logger",
+                )
+            )
+            continue
+        args = node.args
+        is_name = (
+            len(args) == 1
+            and isinstance(args[0], ast.Name)
+            and args[0].id == "__name__"
+        )
+        # bare getLogger() (root logger) is also off-limits in the library
+        if not is_name:
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    "getLogger() must take __name__ so per-module level "
+                    "filters track the module layout",
+                )
+            )
+    return findings

@@ -6,7 +6,10 @@ asserts the three operator-visible planes work over actual HTTP:
 * ``?profile=true`` returns a populated execution profile next to the
   query results;
 * ``/metrics`` carries the ``pilosa_kernel_*`` dispatch telemetry;
-* ``/debug/slow-queries`` serves the bounded slow-query log.
+* ``/debug/slow-queries`` serves the bounded slow-query log;
+* ``/debug/events`` journals the node's own startup;
+* ``/debug/jobs`` shows a completed anti-entropy round;
+* ``/debug/fragments`` reports the written fragment's storage detail.
 
 Exit status 0 on success; any assertion/exception fails the CI step.
 Run as ``python -m tools.smoke_observability``.
@@ -62,6 +65,25 @@ def main() -> int:
 
         vars_ = json.loads(_get(f"{base}/debug/vars"))
         assert "dispatch_lanes" in vars_.get("kernels", {}), vars_.keys()
+        assert "device" in vars_ and "events" in vars_, vars_.keys()
+
+        events = json.loads(_get(f"{base}/debug/events?since=0"))
+        types = [e["type"] for e in events["events"]]
+        assert "node-start" in types, types
+        assert events["truncated"] is False, events
+
+        node.syncer().sync_holder()  # tracked anti-entropy round
+        jobs = json.loads(_get(f"{base}/debug/jobs?kind=antientropy"))
+        assert any(j["status"] == "done" for j in jobs["jobs"]), jobs
+
+        frags = json.loads(_get(f"{base}/debug/fragments?index=smoke"))
+        assert frags["totals"]["fragments"] >= 1, frags
+        assert frags["fragments"][0]["bits"] >= 1, frags
+        assert "usedBytes" in frags["device"], frags
+
+        metrics = _get(f"{base}/metrics").decode()
+        assert "pilosa_job_" in metrics, metrics[:400]
+        assert "pilosa_device_used_bytes" in metrics, metrics[:400]
     finally:
         node.stop()
     print("observability smoke OK")
