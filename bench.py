@@ -175,6 +175,177 @@ def _bsi_range_fn(depth, value):
     return run
 
 
+# Load-generator subprocess for the served-concurrency sweep: argv is
+# host, port, n_threads, per_client.  One keep-alive HTTPConnection per
+# thread; prints one JSON report (latencies, errors, wall clock).
+_SWEEP_CLIENT_SRC = """
+import http.client, json, sys, threading, time
+host, port = sys.argv[1], int(sys.argv[2])
+clients, per_client = int(sys.argv[3]), int(sys.argv[4])
+q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+lats = [[] for _ in range(clients)]
+errors = []
+def worker(ci):
+    conn = None
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.connect()
+    except Exception as e:
+        errors.append(repr(e))
+        return
+    try:
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            conn.request("POST", "/index/swp/query", body=q)
+            resp = conn.getresponse()
+            data = resp.read()
+            lats[ci].append(time.perf_counter() - t0)
+            if resp.status != 200:
+                errors.append(data[:120].decode("utf-8", "replace"))
+        conn.close()
+    except Exception as e:
+        errors.append(repr(e))
+ts = [threading.Thread(target=worker, args=(ci,), daemon=True)
+      for ci in range(clients)]
+t0 = time.perf_counter()
+for t in ts:
+    t.start()
+for t in ts:
+    t.join()
+wall = time.perf_counter() - t0
+print(json.dumps({
+    "lats": [x for lat in lats for x in lat],
+    "errors": errors[:3],
+    "n_errors": len(errors),
+    "wall": wall,
+}))
+"""
+
+
+def _served_concurrency_sweep() -> dict:
+    """Serving-plane lane: a concurrency sweep through the REAL HTTP
+    path (BENCH_r05 follow-up — the engine served 36.5k batched qps
+    while one-at-a-time HTTP requests managed 225; the admission
+    batcher exists to close that gap for *concurrent* callers).
+
+    Boots one NodeServer (admission batcher on), warms the pair-count
+    serving cache, then drives it with 1/32/256/1000 keep-alive clients
+    — one ``http.client.HTTPConnection`` per client thread, so the
+    sweep measures request coalescing, not TCP handshakes.  Per level:
+    achieved qps, p50/p99 latency.  The level-1 row is the
+    single-client floor the window must not regress (the batcher closes
+    "empty" with zero dead time when nobody else is queued); the 1000-
+    client row is the throughput headline.  Also returns the
+    batch-size histogram and window-close counters accumulated across
+    the sweep, so the JSON shows HOW the throughput was achieved."""
+    from pilosa_tpu.server.node import NodeServer
+
+    srv = NodeServer(port=0, batch_window=0.002, batch_max_size=128)
+    srv.start()
+    try:
+        api = srv.api
+        api.create_index("swp")
+        api.create_field("swp", "f")
+        rng = np.random.default_rng(7)
+        width = api.holder.n_words * 32
+        writes = [
+            f"Set({int(c)}, f={row})"
+            for row in range(8)
+            for c in rng.integers(0, width, size=200)
+        ]
+        api.query("swp", " ".join(writes))
+        q = b"Count(Intersect(Row(f=0), Row(f=1)))"
+        want = api.query("swp", q.decode())["results"]
+        # warm the serving cache: the sweep measures the serving plane's
+        # steady state, not the one-time gram build
+        for _ in range(40):
+            api.query("swp", q.decode())
+        host, port = srv.host, srv.server.port
+
+        def run_level(clients: int, per_client: int) -> dict:
+            # Load is generated from SUBPROCESSES (up to 4, splitting the
+            # client threads) so the load generator does not share the
+            # server's GIL — 1000 in-process client threads measure the
+            # generator, not the serving plane.  Each subprocess reports
+            # its own thread-start→join wall; qps uses the slowest one
+            # (they launch together; python startup is outside the wall).
+            n_procs = min(4, clients)
+            split = [clients // n_procs] * n_procs
+            for i in range(clients % n_procs):
+                split[i] += 1
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable, "-c", _SWEEP_CLIENT_SRC,
+                        host, str(port), str(nc), str(per_client),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                )
+                for nc in split
+            ]
+            reports = []
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                reports.append(json.loads(out))
+            flat = sorted(x for r in reports for x in r["lats"])
+            errors = [e for r in reports for e in r["errors"]]
+            n_errors = sum(r["n_errors"] for r in reports)
+            wall = max(r["wall"] for r in reports)
+            n = len(flat)
+            return {
+                "clients": clients,
+                "requests": n,
+                "errors": n_errors,
+                "error_sample": errors[:3],
+                "qps": round(n / wall, 1) if wall > 0 else None,
+                "p50_ms": round(flat[n // 2] * 1e3, 2) if n else None,
+                "p99_ms": (
+                    round(flat[min(n - 1, (99 * n) // 100)] * 1e3, 2)
+                    if n
+                    else None
+                ),
+            }
+
+        snap0 = api.batcher.snapshot()
+        levels = []
+        for clients in (1, 32, 256, 1000):
+            # >=2000 requests per level so p99 means something; at high
+            # concurrency keep >=8 per client so the steady state
+            # outweighs the 1000-connection setup herd
+            levels.append(run_level(clients, max(8, 2000 // clients)))
+        snap1 = api.batcher.snapshot()
+        stats_snap = api.holder.stats.snapshot()
+        hist = next(
+            (
+                v
+                for k, v in stats_snap.get("histograms", {}).items()
+                if "batcher_batch_size" in k
+            ),
+            None,
+        )
+        closes = {
+            k: v
+            for k, v in stats_snap.get("counters", {}).items()
+            if "batcher_window_close" in k
+        }
+        # correctness spot check after the storm: same answer as before
+        got = api.query("swp", q.decode())["results"]
+        if got != want:
+            raise RuntimeError(f"served sweep corrupted results: {got} != {want}")
+        return {
+            "levels": levels,
+            "window_s": api.batcher.window,
+            "max_batch": api.batcher.max_batch,
+            "batches": snap1["batches"] - snap0["batches"],
+            "coalesced": snap1["coalesced"] - snap0["coalesced"],
+            "window_closes": closes,
+            "batch_size_hist": hist,
+        }
+    finally:
+        srv.stop()
+
+
 def _np_bsi_lt(planes, exists, sign, value, depth):
     """CPU baseline: the same bit-sliced scan in vectorized numpy."""
     lt = np.zeros_like(exists)
@@ -498,6 +669,10 @@ def main() -> None:
         "serving_range_count_ms": _served_ms("Count(Row(v < 500000))"),
     }
 
+    # -- served concurrency sweep: the continuous-batching plane through
+    # the real HTTP listener (one keep-alive connection per client)
+    served_sweep = _served_concurrency_sweep()
+
     # -- ingest: cold bulk import + sustained steady-state ------------------
     # Cold: one vectorized bulk import + HBM upload (fragment.import_bits).
     # Sustained: multi-batch run with the op-log store attached — each
@@ -813,6 +988,13 @@ def main() -> None:
             round(ref_seq_qps, 1) if ref_seq_qps else None
         ),
         **{k: round(v, 3) for k, v in serving.items()},
+        # HTTP-path concurrency sweep (continuous-batching serving
+        # plane): per-level qps + p50/p99, batch-size histogram, and
+        # window-close counters — levels[0] is the single-client floor,
+        # levels[-1] the 1000-client throughput headline
+        "served_http_sweep": served_sweep,
+        "served_http_qps_1_client": served_sweep["levels"][0]["qps"],
+        "served_http_qps_1k_clients": served_sweep["levels"][-1]["qps"],
         "probe": _PROBE_ATTEMPTS,
         # dispatch-lane / compile-cache / transfer accounting for the
         # whole run: says WHICH lane produced the numbers above (a

@@ -79,6 +79,26 @@ def expired() -> bool:
     return r is not None and r <= 0
 
 
+def at() -> float | None:
+    """Absolute monotonic deadline of the active budget (None when no
+    deadline is set).  For handing a budget across threads: the serving
+    plane's dispatcher (``server/batcher.py``) runs outside the request
+    context, so the submitting thread snapshots this value into the
+    queue item and the dispatcher compares it against
+    ``time.monotonic()`` directly."""
+    return _deadline.get()
+
+
+def would_expire_within(seconds: float) -> bool:
+    """Queue-time admission accounting: True when the active budget
+    cannot survive ``seconds`` more of waiting.  The batcher uses this
+    to classify a request as too close to its deadline to queue — it
+    must dispatch immediately (or 504) rather than wait out a batch
+    window it cannot afford.  False when no deadline is set."""
+    r = remaining()
+    return r is not None and r <= seconds
+
+
 def check(what: str = "") -> None:
     """Raise :class:`DeadlineExceeded` if the active budget is exhausted."""
     r = remaining()

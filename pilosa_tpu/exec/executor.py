@@ -189,6 +189,82 @@ class Executor:
                 self._translate_result(idx, c, r) for c, r in zip(q.calls, results)
             ]
 
+    def execute_batch(
+        self,
+        index_name: str,
+        queries: list[tuple[str | pql.Query, list[int] | None]],
+    ) -> list[Any]:
+        """Cross-request micro-batch entry point (the continuous-batching
+        serving plane, server/batcher.py): execute several independent
+        read-only queries as ONE pass through the batched fast paths, so
+        concurrent HTTP requests share gram/AST-batch device launches
+        instead of each paying its own host→device round trip.
+
+        ``queries`` is ``[(query, shards), ...]``.  Returns one slot per
+        query: the query's result list, or the exception it raised —
+        per-query isolation, one malformed query must not fail the
+        flight it shares a window with.  A query that turns out to
+        carry writes falls back to the ordinary in-order :meth:`execute`
+        path (the batcher filters writes out already; this is the
+        defensive second fence).  Queries with differing shard
+        restrictions batch within their shard group."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            err = IndexNotFoundError(f"index not found: {index_name}")
+            return [err for _ in queries]
+        n = len(queries)
+        out: list[Any] = [None] * n
+        parsed: list[pql.Query | None] = [None] * n
+        cloned: list[list[Call] | None] = [None] * n
+        with tracing.start_span("executor.ExecuteBatch").set_tag(
+            "index", index_name
+        ).set_tag("queries", n):
+            # Per-query translate, grouped by shard restriction so the
+            # flat batch passes see one consistent shard list.
+            groups: dict[tuple[int, ...] | None, list[int]] = {}
+            for qi, (query, shards) in enumerate(queries):
+                try:
+                    q = pql.parse(query) if isinstance(query, str) else query
+                    if q.write_calls():
+                        out[qi] = self.execute(index_name, q, shards=shards)
+                        continue
+                    parsed[qi] = q
+                    calls = [c.clone() for c in q.calls]
+                    for call in calls:
+                        self._translate_call(idx, call)
+                    cloned[qi] = calls
+                    key = tuple(sorted(shards)) if shards else None
+                    groups.setdefault(key, []).append(qi)
+                except Exception as e:
+                    out[qi] = e
+            for key, qis in groups.items():
+                shards = list(key) if key is not None else None
+                flat_calls = [c for qi in qis for c in cloned[qi]]
+                flat_results: list[Any] = [_UNSET] * len(flat_calls)
+                self._batch_pair_counts(idx, flat_calls, shards, flat_results)
+                self._batch_general(idx, flat_calls, shards, flat_results)
+                pos = 0
+                for qi in qis:
+                    calls = cloned[qi]
+                    res = flat_results[pos:pos + len(calls)]
+                    pos += len(calls)
+                    try:
+                        for ci, call in enumerate(calls):
+                            if res[ci] is _UNSET:
+                                with tracing.start_span(
+                                    f"executor.execute{call.name}"
+                                ):
+                                    res[ci] = self._execute_call(
+                                        idx, call, shards
+                                    )
+                        out[qi] = [
+                            self._translate_result(idx, c, r)
+                            for c, r in zip(parsed[qi].calls, res)
+                        ]
+                    except Exception as e:
+                        out[qi] = e
+        return out
+
     # ----------------------------------------------- batched Count fast path
 
     def _match_pair_count(self, idx: Index, call: Call):

@@ -186,6 +186,25 @@ def record_kernel(**rec) -> None:
         node.kernels.append(rec)
 
 
+def annotate(name: str, duration_ms: float | None = None, **tags) -> None:
+    """Append a pre-measured child span to the current profile node.
+
+    For stages timed OUTSIDE the request's own context: the batcher's
+    dispatcher thread measures queue wait and batch dispatch without an
+    active profile, and the submitting thread records those numbers
+    into its own profile after wake-up.  No-op without a profile."""
+    prof = _active.get()
+    if prof is None:
+        return
+    parent = _current_node.get() or prof.root
+    node = _PNode(name)
+    node.duration_ms = duration_ms
+    if tags:
+        node.tags.update(tags)
+    with prof._lock:
+        parent.children.append(node)
+
+
 def incr(name: str, n: float = 1) -> None:
     """Bump a per-node counter (serving-cache hits and friends)."""
     prof = _active.get()

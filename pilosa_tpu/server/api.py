@@ -75,6 +75,8 @@ class API:
         import_workers: int = 2,
         import_queue_depth: int = 16,
         max_writes_per_request: int | None = None,
+        batch_window: float = 0.002,
+        batch_max_size: int = 64,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -116,6 +118,20 @@ class API:
             workers=import_workers, depth=import_queue_depth,
             jobs=self.holder.jobs,
         )
+        # Continuous-batching serving plane (server/batcher.py):
+        # concurrent read-only queries coalesce into micro-batched
+        # executor dispatches.  ``batch_window<=0`` or ``batch_max_size
+        # <=1`` disables it — every query takes the direct path.
+        from pilosa_tpu.server.batcher import QueryBatcher
+
+        self.batcher = None
+        if batch_window > 0 and batch_max_size > 1:
+            self.batcher = QueryBatcher(
+                self.executor,
+                stats=self.holder.stats,
+                window=batch_window,
+                max_batch=batch_max_size,
+            )
 
     @property
     def state(self) -> str:
@@ -198,13 +214,8 @@ class API:
 
                         results = self.dist.execute_remote(index, pql, shards)
                         resp = {"wireResults": encode_results(results)}
-                    elif self.dist is not None:
-                        results = self.dist.execute(index, pql, shards=shards)
-                        resp = {"results": result_to_json(results)}
                     else:
-                        results = self.executor.execute(
-                            index, pql, shards=shards
-                        )
+                        results = self._execute_query(index, pql, shards)
                         resp = {"results": result_to_json(results)}
                 except (ExecuteError, ParseError, ValueError, TypeError) as e:
                     err = str(e)
@@ -220,6 +231,26 @@ class API:
         if prof is not None and profile:
             resp["profile"] = prof.to_dict()
         return resp
+
+    def _execute_query(self, index: str, pql_text: str, shards):
+        """Route one local query: read-only queries on a single node
+        ride the continuous-batching plane (``batcher.submit`` parks
+        this handler thread until its micro-batch lands); writes and
+        true multi-node fan-outs keep the direct path — writes for
+        strict in-order semantics, fan-outs because the distributed
+        executor batches per-hop itself (ROADMAP item 4)."""
+        from pilosa_tpu import pql
+
+        batcher = self.batcher
+        single = self.dist is None or self.dist._single
+        if batcher is not None and single:
+            q = pql.parse(pql_text)
+            if batcher.accepts(q):
+                return batcher.submit(index, q, shards=shards)
+            pql_text = q  # already parsed; don't parse twice below
+        if self.dist is not None:
+            return self.dist.execute(index, pql_text, shards=shards)
+        return self.executor.execute(index, pql_text, shards=shards)
 
     # -- schema CRUD (reference api.go:161-495) -----------------------------
 
@@ -1183,6 +1214,8 @@ class API:
             self.store.sync()
 
     def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()  # drains the admission queue first
         self.import_pool.close()
         if self.store is not None:
             self.store.close()

@@ -274,6 +274,10 @@ class Handler(BaseHTTPRequestHandler):
         snap["kernels"] = kernels.telemetry_snapshot()
         snap["device"] = membudget.default_budget().snapshot()
         snap["events"] = self.api.holder.events.snapshot_summary()
+        batcher = getattr(self.api, "batcher", None)
+        if batcher is not None:
+            # serving-plane block: queue depth, window knobs, flights
+            snap["batcher"] = batcher.snapshot()
         self._send_json(200, snap)
 
     def r_debug_events(self):
@@ -593,7 +597,14 @@ class Server:
                 "paused": threading.Event(),
             },
         )
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+
+        class _Listener(ThreadingHTTPServer):
+            # The serving plane holds ~1k concurrent clients parked on
+            # the batcher; socketserver's default listen backlog of 5
+            # resets connections the accept loop hasn't reached yet.
+            request_queue_size = 1024
+
+        self.httpd = _Listener((host, port), handler)
         self.tls = bool(tls_cert)
         if tls_cert:
             import ssl

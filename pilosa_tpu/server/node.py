@@ -54,6 +54,8 @@ class NodeServer:
         breaker_threshold: int = 5,
         breaker_cooldown: float = 2.0,
         slow_query_time: float = 0.0,
+        batch_window: float = 0.002,
+        batch_max_size: int = 64,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -104,6 +106,8 @@ class NodeServer:
             import_workers=import_workers,
             import_queue_depth=import_queue_depth,
             max_writes_per_request=max_writes_per_request,
+            batch_window=batch_window,
+            batch_max_size=batch_max_size,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

@@ -1,0 +1,398 @@
+"""Continuous-batching serving plane tests (server/batcher.py).
+
+Window-close policy (size vs age vs empty vs deadline), deadline
+accounting (admission 504, near-budget bypass, expiry-in-queue without
+dispatch), write bypass, demultiplexing under injected faults
+(testing/faults.py slow/error rules driving the executor stub), clean
+shutdown drain, and the end-to-end API integration incl.
+``profile=True`` queue-wait/batch-size attribution.
+
+The unit tests drive a QueryBatcher against a stub executor so window
+mechanics are deterministic: the stub can be gated shut (parks the
+dispatcher mid-flight while the queue fills behind it) and consults the
+fault registry per query.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu import deadline, pql
+from pilosa_tpu.deadline import DeadlineExceeded
+from pilosa_tpu.obs import qprofile
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.batcher import QueryBatcher
+from pilosa_tpu.testing import faults
+
+
+class StubExecutor:
+    """Records every dispatch.  ``gate`` (when cleared) parks
+    execute_batch — ``entered`` signals the dispatcher reached it — and
+    each query consults the fault registry (kind ``slow`` stalls, kind
+    ``error`` fails that one query: the demux-under-faults rig)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.entered = threading.Event()
+        self.batches: list[list] = []
+        self.direct: list = []
+
+    def execute(self, index, query, shards=None):
+        self.direct.append(query)
+        return [f"direct:{query}"]
+
+    def execute_batch(self, index, queries):
+        self.entered.set()
+        self.gate.wait(10)
+        self.batches.append([q for q, _ in queries])
+        out = []
+        for q, _ in queries:
+            try:
+                injected = faults.network_fault("batcher", str(q), timeout=1.0)
+                if injected is not None:
+                    code, _body, _ct = injected
+                    raise RuntimeError(f"fault-injected error {code}")
+                out.append([f"r:{q}"])
+            except Exception as e:
+                out.append(e)
+        return out
+
+
+def submit_profiled(batcher, query, index="i"):
+    """Submit under a fresh profile; returns (result, queueWait tags)."""
+    prof = qprofile.QueryProfile(index, str(query))
+    with qprofile.activate(prof):
+        res = batcher.submit(index, query)
+    spans = {c.name: c for c in prof.root.children}
+    assert "batcher.queueWait" in spans, spans
+    assert "batcher.dispatch" in spans, spans
+    return res, spans["batcher.queueWait"].tags
+
+
+@pytest.fixture
+def stub():
+    return StubExecutor()
+
+
+@pytest.fixture
+def batcher(stub):
+    b = QueryBatcher(stub, window=0.25, max_batch=4)
+    yield b
+    stub.gate.set()
+    b.close()
+
+
+def _bg(fn, *args):
+    t = threading.Thread(target=fn, args=args, daemon=True)
+    t.start()
+    return t
+
+
+def _park_dispatcher(batcher, stub):
+    """Close the stub's gate and feed a sacrificial request, so the
+    dispatcher thread is parked mid-flight while tests fill the queue."""
+    stub.gate.clear()
+    stub.entered.clear()
+    t = _bg(batcher.submit, "i", "sacrificial")
+    assert stub.entered.wait(5), "dispatcher never reached execute_batch"
+    return t
+
+
+def _wait_depth(batcher, n):
+    for _ in range(400):
+        with batcher._lock:
+            if batcher._depth == n:
+                return
+        time.sleep(0.005)
+    raise AssertionError(f"queue never reached depth {n}")
+
+
+# -- window policy -----------------------------------------------------------
+
+
+def test_single_request_closes_empty_without_dead_time(batcher):
+    # window is 0.25s; a lone client must not pay any of it
+    t0 = time.perf_counter()
+    res, tags = submit_profiled(batcher, "q0")
+    elapsed = time.perf_counter() - t0
+    assert res == ["r:q0"]
+    assert tags["closeReason"] == "empty"
+    assert tags["batchSize"] == 1
+    assert elapsed < 0.2, f"lone request waited the window: {elapsed:.3f}s"
+
+
+def test_window_closes_by_size(batcher, stub):
+    sac = _park_dispatcher(batcher, stub)
+    outcomes = []
+    ts = [
+        _bg(lambda q=f"q{i}": outcomes.append(submit_profiled(batcher, q)))
+        for i in range(4)  # == max_batch
+    ]
+    _wait_depth(batcher, 5)  # all four queued behind the parked flight
+    stub.gate.set()
+    for t in [sac, *ts]:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert [len(b) for b in stub.batches] == [1, 4]
+    assert {tags["closeReason"] for _, tags in outcomes} == {"size"}
+    assert {tags["batchSize"] for _, tags in outcomes} == {4}
+    assert batcher.coalesced == 4
+
+
+def test_window_closes_by_age(stub):
+    b = QueryBatcher(stub, window=0.05, max_batch=100)
+    try:
+        # make the queue look permanently non-empty: collection then
+        # rides timed gets until the window expires (the sustained-
+        # arrival regime, without timing-sensitive submit staggering)
+        b._q.empty = lambda: False
+        t0 = time.perf_counter()
+        res, tags = submit_profiled(b, "q0")
+        elapsed = time.perf_counter() - t0
+    finally:
+        del b._q.empty
+        b.close()
+    assert res == ["r:q0"]
+    assert tags["closeReason"] == "age"
+    assert elapsed >= 0.04, f"closed before the window aged out: {elapsed:.3f}s"
+
+
+# -- deadline accounting -----------------------------------------------------
+
+
+def test_expired_budget_504s_at_admission(batcher, stub):
+    with deadline.scope(1e-9):
+        with pytest.raises(DeadlineExceeded):
+            batcher.submit("i", "q-expired")
+    assert not stub.batches and not stub.direct
+
+
+def test_near_budget_request_bypasses_queue(batcher, stub):
+    # budget (50ms) < window (250ms): dispatch immediately, solo
+    with deadline.scope(0.05):
+        res = batcher.submit("i", "q-urgent")
+    assert res == ["direct:q-urgent"]
+    assert stub.direct == ["q-urgent"]
+    assert stub.batches == []
+
+
+def test_queued_request_expiring_504s_without_dispatch(stub):
+    b = QueryBatcher(stub, window=0.001, max_batch=4)
+    try:
+        sac = _park_dispatcher(b, stub)
+        err: list = []
+
+        def victim():
+            with deadline.scope(0.05):  # > window: queues, then expires
+                try:
+                    b.submit("i", "q-doomed")
+                except BaseException as e:
+                    err.append(e)
+
+        t = _bg(victim)
+        t.join(timeout=5)
+        stub.gate.set()
+        sac.join(timeout=10)
+        b.close()  # drains: the doomed item is demuxed expired
+        assert err and isinstance(err[0], DeadlineExceeded)
+        assert all("q-doomed" not in batch for batch in stub.batches), (
+            "expired request still paid device work"
+        )
+    finally:
+        stub.gate.set()
+        b.close()
+
+
+def test_member_turning_urgent_in_queue_closes_window(stub):
+    # admitted with budget > window, but the budget decays to < window
+    # while parked behind an in-flight batch: collection must close
+    # "deadline" and dispatch at once instead of waiting out the window
+    b = QueryBatcher(stub, window=0.2, max_batch=100)
+    try:
+        sac = _park_dispatcher(b, stub)
+        outcome: list = []
+
+        def victim():
+            with deadline.scope(0.6):
+                outcome.append(submit_profiled(b, "q-tight"))
+
+        t = _bg(victim)
+        _wait_depth(b, 2)
+        time.sleep(0.45)  # remaining ~0.15 < window 0.2, not yet expired
+        stub.gate.set()
+        for th in (sac, t):
+            th.join(timeout=10)
+            assert not th.is_alive()
+        res, tags = outcome[0]
+        assert res == ["r:q-tight"]
+        assert tags["closeReason"] == "deadline"
+    finally:
+        stub.gate.set()
+        b.close()
+
+
+# -- demux under injected faults --------------------------------------------
+
+
+def test_demux_isolates_faulted_members(batcher, stub):
+    reg = faults.install(faults.FaultRegistry(seed=7))
+    try:
+        reg.add("error", route="q-err", code=503)
+        reg.add("slow", route="q-slow", delay=0.05)
+        sac = _park_dispatcher(batcher, stub)
+        results: dict = {}
+
+        def run(q):
+            try:
+                results[q] = batcher.submit("i", q)
+            except Exception as e:
+                results[q] = e
+
+        ts = [_bg(run, q) for q in ("q-ok1", "q-err", "q-slow", "q-ok2")]
+        _wait_depth(batcher, 5)
+        stub.gate.set()
+        for t in [sac, *ts]:
+            t.join(timeout=10)
+        # one flight of four, each member demuxed to its own outcome
+        assert sorted(stub.batches[1]) == ["q-err", "q-ok1", "q-ok2", "q-slow"]
+        assert results["q-ok1"] == ["r:q-ok1"]
+        assert results["q-ok2"] == ["r:q-ok2"]
+        assert results["q-slow"] == ["r:q-slow"]  # stalled, not failed
+        assert isinstance(results["q-err"], RuntimeError)
+        assert "fault-injected" in str(results["q-err"])
+    finally:
+        faults.uninstall(reg)
+
+
+# -- shutdown ----------------------------------------------------------------
+
+
+def test_close_drains_queue(stub):
+    b = QueryBatcher(stub, window=0.25, max_batch=16)
+    sac = _park_dispatcher(b, stub)
+    results: dict = {}
+    ts = [
+        _bg(lambda q=f"q{i}": results.__setitem__(q, b.submit("i", q)))
+        for i in range(3)
+    ]
+    _wait_depth(b, 4)
+    closer = _bg(b.close)
+    time.sleep(0.05)  # close() must wait out the drain, not race it
+    stub.gate.set()
+    closer.join(timeout=10)
+    assert not closer.is_alive(), "close() did not finish after the drain"
+    for t in [sac, *ts]:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert results == {f"q{i}": [f"r:q{i}"] for i in range(3)}
+    # after close, admission degrades to the direct path
+    assert b.submit("i", "late") == ["direct:late"]
+    assert "late" in stub.direct
+
+
+def test_double_close_is_idempotent(stub):
+    b = QueryBatcher(stub, window=0.01, max_batch=4)
+    b.close()
+    b.close()
+
+
+# -- API integration ---------------------------------------------------------
+
+
+def _mk_api():
+    api = API(batch_window=0.25, batch_max_size=64)
+    api.create_index("t")
+    api.create_field("t", "f")
+    api.query("t", "Set(3, f=1)")
+    api.query("t", "Set(5, f=1)")
+    api.query("t", "Set(5, f=2)")
+    return api
+
+
+def test_write_queries_bypass_the_batch():
+    api = _mk_api()
+    try:
+        assert api.batcher is not None
+        assert api.batcher.dispatched == 0, "a write rode the batch plane"
+        assert not api.batcher.accepts(pql.parse("Set(9, f=1)"))
+        assert api.batcher.accepts(pql.parse("Count(Row(f=1))"))
+        assert api.query("t", "Count(Row(f=1))")["results"] == [2]
+        assert api.batcher.dispatched == 1
+    finally:
+        api.close()
+
+
+def test_concurrent_queries_coalesce_into_one_flight():
+    api = _mk_api()
+    real = api.executor
+    gate = threading.Event()
+    try:
+        parked = threading.Event()
+
+        class Gated:
+            """First flight parks inside dispatch; the rest pile up."""
+
+            def execute(self, index, query, shards=None):
+                return real.execute(index, query, shards=shards)
+
+            def execute_batch(self, index, queries):
+                if not parked.is_set():
+                    parked.set()
+                    gate.wait(10)
+                return real.execute_batch(index, queries)
+
+        api.batcher.executor = Gated()
+        outcomes: list = []
+        sac = _bg(api.query, "t", "Count(Row(f=2))")
+        assert parked.wait(5)
+        ts = [
+            _bg(
+                lambda: outcomes.append(
+                    api.query("t", "Count(Row(f=1))", profile=True)
+                )
+            )
+            for _ in range(8)
+        ]
+        _wait_depth(api.batcher, 9)
+        gate.set()
+        for t in [sac, *ts]:
+            t.join(timeout=10)
+            assert not t.is_alive()
+        assert len(outcomes) == 8
+        for resp in outcomes:
+            assert resp["results"] == [2]
+            spans = {
+                c["name"]: c for c in resp["profile"]["tree"]["children"]
+            }
+            wait_span = spans["batcher.queueWait"]
+            assert wait_span["tags"]["batchSize"] == 8
+            assert wait_span["tags"]["closeReason"] in ("empty", "size")
+            assert "batcher.dispatch" in spans
+            # the flight's shared execution profile is grafted under
+            # each member, so kernel attribution survives batching
+            sub = resp["profile"]["tree"].get("subprofiles")
+            assert sub and sub[0]["node"] == "batcher", resp["profile"]
+    finally:
+        gate.set()
+        api.batcher.executor = real
+        api.close()
+
+
+def test_metrics_emitted():
+    from pilosa_tpu.obs.stats import MemStatsClient
+
+    stub = StubExecutor()
+    stats = MemStatsClient()
+    b = QueryBatcher(stub, stats=stats, window=0.05, max_batch=4)
+    try:
+        assert b.submit("i", "q0") == ["r:q0"]
+    finally:
+        b.close()
+    flat = str(stats.snapshot())
+    assert "batcher_window_close" in flat
+    assert "batcher_batch_size" in flat
+    assert "batcher_queue_wait" in flat

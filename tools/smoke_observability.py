@@ -9,7 +9,10 @@ asserts the three operator-visible planes work over actual HTTP:
 * ``/debug/slow-queries`` serves the bounded slow-query log;
 * ``/debug/events`` journals the node's own startup;
 * ``/debug/jobs`` shows a completed anti-entropy round;
-* ``/debug/fragments`` reports the written fragment's storage detail.
+* ``/debug/fragments`` reports the written fragment's storage detail;
+* a concurrent query burst rides the continuous-batching serving plane
+  (``pilosa_batcher_*`` in ``/metrics``, a ``batcher`` block in
+  ``/debug/vars``, ``batcher.queueWait`` attribution in the profile).
 
 Exit status 0 on success; any assertion/exception fails the CI step.
 Run as ``python -m tools.smoke_observability``.
@@ -84,6 +87,50 @@ def main() -> int:
         metrics = _get(f"{base}/metrics").decode()
         assert "pilosa_job_" in metrics, metrics[:400]
         assert "pilosa_device_used_bytes" in metrics, metrics[:400]
+
+        # -- continuous-batching serving plane: a concurrent burst must
+        # coalesce, and every observability surface must show it
+        import threading
+
+        burst_errors: list[str] = []
+
+        def _burst_client(n: int) -> None:
+            try:
+                for _ in range(n):
+                    out = json.loads(
+                        _post(f"{base}/index/smoke/query", b"Count(Row(f=1))")
+                    )
+                    assert out["results"] == [1], out
+            except Exception as e:
+                burst_errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=_burst_client, args=(10,), daemon=True)
+            for _ in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not burst_errors, burst_errors[:3]
+
+        metrics = _get(f"{base}/metrics").decode()
+        assert "pilosa_batcher_depth" in metrics, metrics[:400]
+        assert "pilosa_batcher_window_close" in metrics, metrics[:400]
+        assert "pilosa_batcher_batch_size" in metrics, metrics[:400]
+        assert "pilosa_batcher_queue_wait_seconds" in metrics, metrics[:400]
+
+        vars_ = json.loads(_get(f"{base}/debug/vars"))
+        snap = vars_.get("batcher")
+        assert snap, "no batcher block in /debug/vars"
+        assert snap["batches"] >= 1 and snap["depth"] == 0, snap
+
+        resp = json.loads(
+            _post(f"{base}/index/smoke/query?profile=true", b"Count(Row(f=1))")
+        )
+        names = [c["name"] for c in resp["profile"]["tree"]["children"]]
+        assert "batcher.queueWait" in names, names
+        assert "batcher.dispatch" in names, names
     finally:
         node.stop()
     print("observability smoke OK")
