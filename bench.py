@@ -757,6 +757,116 @@ def main() -> None:
             sq.stop()
             store.close()
 
+    # -- pipelined ingest: the staged pipeline (native zero-copy decode
+    # -> coalesced apply on the worker pool -> double-buffered device
+    # upload) vs the lock-step path (decode, merge, device sync, one
+    # batch at a time on one thread) over the SAME pre-serialized
+    # roaring segments, round-robined across shards so uploads of one
+    # fragment overlap applies of another.
+    from pilosa_tpu.ingest import IngestPipeline
+    from pilosa_tpu.server.importpool import ImportPool
+    from pilosa_tpu.storage import roaring as _roaring
+
+    # Few shards + many queued batches: the shape that starves the
+    # lock-step path (a device sync per batch, serialized behind every
+    # apply) and that the pipeline's group-commit exists for — queued
+    # same-fragment batches coalesce into one merged apply and pending
+    # device syncs dedup, so the HBM refresh cost is paid per
+    # convergence, not per batch.  Both paths keep the serving copy
+    # device-resident across the run (every applied batch is synced).
+    from pilosa_tpu.shardwidth import SHARD_WORDS as _pW
+
+    # Production shard width for BOTH paths (the bench's CPU-scaled W
+    # would shrink the per-batch HBM refresh to noise and hide exactly
+    # the cost the pipeline amortizes).
+    n_shards_p = 2
+    p_batches, p_batch = (64, 200_000) if accel else (64, 50_000)
+    width64 = np.uint64(_pW * 32)
+    pip_rng = np.random.default_rng(23)
+    p_blobs = []
+    p_total = 0
+    for bi in range(p_batches):
+        pos = np.unique(
+            pip_rng.integers(0, 64 * _pW * 32, size=p_batch).astype(np.uint64)
+        )
+        p_total += len(pos)
+        p_blobs.append((bi % n_shards_p, _roaring.serialize(pos)))
+
+    def _lockstep_run():
+        frags = {s: Fragment(n_words=_pW) for s in range(n_shards_p)}
+        t0 = time.perf_counter()
+        for shard, blob in p_blobs:
+            positions = _roaring.deserialize(blob)
+            frags[shard].import_bits(
+                positions // width64,
+                (positions % width64).astype(np.int64),
+            )
+            frags[shard].device_bits()  # serialized per-batch upload
+        return p_total / (time.perf_counter() - t0)
+
+    def _pipelined_run():
+        frags = {s: Fragment(n_words=_pW) for s in range(n_shards_p)}
+        pool = ImportPool(workers=2, depth=2 * p_batches)
+        # staging sized to the batch (the 1M-position default would
+        # lazily fault ~0.5GB across 64 buffers and swamp the timing)
+        pipe = IngestPipeline(
+            pool,
+            staging_buffers=p_batches,
+            staging_capacity=1 << 18 if accel else 1 << 17,
+            upload_slots=2,
+        )
+        t0 = time.perf_counter()
+        # decode stage runs as a prefetch: every blob lands in staging
+        # before the drain is awaited, so the apply stage sees the whole
+        # backlog and group-commit merges it per fragment (interleaving
+        # decode with the drain instead leaves coalescing at the mercy
+        # of worker scheduling — the merged-apply count, and with it the
+        # measured rate, becomes a coin flip)
+        staged = [(s, pipe.decode_roaring(blob)) for s, blob in p_blobs]
+        handles = []
+        for shard, buf in staged:
+            frag = frags[shard]
+
+            # same shape as ApiServer.import_roaring's group apply:
+            # per-payload merges under one pool job, one device sync
+            def apply_group(payloads, _frag=frag):
+                changed = 0
+                for b in payloads:
+                    positions = b.positions
+                    changed += _frag.import_bits(
+                        positions // width64,
+                        (positions % width64).astype(np.int64),
+                    )
+                return changed, _frag
+
+            handles.append(
+                pipe.submit_segment(
+                    id(frag), buf, apply_group, release=lambda b: b.release()
+                )
+            )
+        pipe.drain(handles)
+        pipe.uploader.flush()
+        rate = p_total / (time.perf_counter() - t0)
+        frac = pipe.overlap_frac
+        pipe.close()
+        pool.close()
+        return rate, frac
+
+    # warm the production-width device-sync programs outside the timed
+    # region (the cold burst above compiled the CPU-scaled W shapes)
+    _pwarm = Fragment(n_words=_pW)
+    _pwarm.import_bits(ing_rows[:4096], ing_cols[:4096] % (_pW * 32))
+    _sync(_pwarm.device_bits())
+    del _pwarm
+
+    # best-of-2 each, symmetric noise discipline; overlap is best
+    # observed across runs (whether the last upload catches the other
+    # fragment's apply is scheduler timing — a miss is noise downward)
+    lockstep_ingest_bits_s = max(_lockstep_run() for _ in range(2))
+    _p_runs = [_pipelined_run() for _ in range(2)]
+    pipelined_ingest_bits_s = max(r for r, _ in _p_runs)
+    ingest_overlap_frac = max(f for _, f in _p_runs)
+
     # CPU anchor for ingest (vs_baseline): the same semantic work —
     # dedup + mirror merge + changed-position extraction + checksummed
     # WAL append with per-batch fsync + snapshot rewrite past MaxOpN —
@@ -957,6 +1067,16 @@ def main() -> None:
         "sustained_ingest_vs_baseline": round(
             sustained_bits_s / cpu_ingest_bits_s, 1
         ),
+        # staged-pipeline lane (pilosa_tpu/ingest/): same roaring
+        # segments through the pipeline vs the lock-step path;
+        # overlap_frac = fraction of H2D bytes whose upload ran while an
+        # apply was in flight
+        "pipelined_ingest_bits_s": round(pipelined_ingest_bits_s, 0),
+        "lockstep_ingest_bits_s": round(lockstep_ingest_bits_s, 0),
+        "pipelined_ingest_vs_lockstep": round(
+            pipelined_ingest_bits_s / lockstep_ingest_bits_s, 2
+        ),
+        "ingest_overlap_frac": round(ingest_overlap_frac, 3),
         "cpu_ingest_bits_s": round(cpu_ingest_bits_s, 0),
         "cpu_baseline_qps": round(cpu_qps, 1),
         "platform": jax.devices()[0].platform,

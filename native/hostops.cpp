@@ -177,7 +177,9 @@ size_t ph_extract(const uint8_t* words, size_t n_words, uint64_t base,
 // sets/clears mirror bits, and emits, in one walk, everything the
 // Python layer needs afterwards:
 //   wal_pos[c]        changed positions as original-row-id*width+col
-//                     (ascending row-major, the op-log record order)
+//                     (ascending row-major, the op-log record order);
+//                     nullable — store-less fragments (ingest staging,
+//                     benches) skip the extraction and its allocation
 //   perrow[ri]        changed-bit count per row index (TopN maintained
 //                     counts + dirty-slot set)
 //   changed_words[w]  flat mirror word indices that changed, deduped
@@ -245,7 +247,7 @@ int64_t ph_import_merge(const int64_t* keys, size_t n, int64_t width,
             if (word & bit) continue;
             word |= bit;
         }
-        wal_pos[nc] = wal_base + static_cast<uint64_t>(col);
+        if (wal_pos) wal_pos[nc] = wal_base + static_cast<uint64_t>(col);
         perrow[ri]++;
         nc++;
         int64_t flat = slots[ri] * n_words + w;

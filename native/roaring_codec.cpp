@@ -550,6 +550,23 @@ int rt_deserialize(const uint8_t* data, size_t len, uint64_t** out,
   return 0;
 }
 
+// Decode straight into a caller-owned buffer (the ingest staging path:
+// the positions land in a reusable pinned buffer, no malloc/copy pair
+// per batch).  Returns 0 on success, 1 on parse error, 3 when the
+// buffer is too small — *out_n then holds the required capacity so the
+// caller can grow and retry.
+int rt_deserialize_into(const uint8_t* data, size_t len, uint64_t* out,
+                        size_t cap, size_t* out_n, uint64_t* op_count) {
+  std::vector<uint64_t> positions;
+  uint64_t ops = 0;
+  if (!deserialize_any(data, len, &positions, &ops)) return 1;
+  *out_n = positions.size();
+  *op_count = ops;
+  if (positions.size() > cap) return 3;
+  std::memcpy(out, positions.data(), positions.size() * 8);
+  return 0;
+}
+
 uint32_t rt_fnv32a(const uint8_t* data, size_t len, uint32_t h) {
   // exposed for the op-log writer: the Python FNV loop is ~7 MB/s and
   // dominates sustained-ingest batches (encode_op checksums)

@@ -221,14 +221,16 @@ def decode_import(body: bytes) -> dict:
     width = np.uint64(header["width"])
     all_rows: list[np.ndarray] = []
     all_cols: list[np.ndarray] = []
+    segments: list[tuple] = []
     for meta in header["shards"]:
         blob = body[off : off + meta["len"]]
         off += meta["len"]
         positions = roaring.deserialize(blob)
-        all_rows.append(positions // width)
-        all_cols.append(
-            np.uint64(meta["s"]) * width + positions % width
-        )
+        seg_rows = positions // width
+        seg_offs = positions % width
+        all_rows.append(seg_rows)
+        all_cols.append(np.uint64(meta["s"]) * width + seg_offs)
+        segments.append((int(meta["s"]), seg_rows, seg_offs))
     rows = np.concatenate(all_rows) if all_rows else np.zeros(0, np.uint64)
     cols = np.concatenate(all_cols) if all_cols else np.zeros(0, np.uint64)
     return {
@@ -236,4 +238,7 @@ def decode_import(body: bytes) -> dict:
         "columnIDs": cols,
         "clear": clear,
         "remote": remote,
+        # The wire format is already split per shard — hand the split to
+        # field.import_bits so the pipeline can skip re-deriving it.
+        "_segments": segments,
     }

@@ -305,8 +305,20 @@ class Field:
         cols: Iterable[int],
         timestamps: Iterable[datetime | None] | None = None,
         clear: bool = False,
+        pipeline=None,
+        segments=None,
     ) -> None:
-        """Routes (row, col[, ts]) triples to per-shard fragments."""
+        """Routes (row, col[, ts]) triples to per-shard fragments.
+
+        With a ``pipeline`` (ingest.IngestPipeline), the per-shard
+        merges become sharded drains: every fragment's segment is
+        submitted to the bounded import pool before any is awaited, so
+        distinct shards merge on different workers, queued same-fragment
+        segments coalesce into one merged apply, and each applied
+        fragment's device upload overlaps the next segment's merge.
+        ``segments`` optionally carries the batch pre-split by shard
+        (``[(shard, rows, offs), ...]`` — the binary wire decoder
+        already has it), skipping the sort/mask split here."""
         if clear and timestamps is not None:
             # reference field.go:1180
             raise ValueError("import clear is not supported with timestamps")
@@ -319,18 +331,43 @@ class Field:
         span.set_tag("bits", int(len(cols)))
         with span:
             width = self.n_words * 32
-            shards = cols // width
-            offs = cols % width
             std = None if self.options.no_standard_view else self.create_view_if_not_exists(VIEW_STANDARD)
-            for shard in np.unique(shards):
-                m = shards == shard
-                if std is not None:
-                    frag = std.create_fragment_if_not_exists(int(shard))
-                    if self.field_type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL) and not clear:
-                        for r, c in zip(rows[m], offs[m]):
-                            frag.set_mutex(int(r), int(c))
-                    else:
-                        frag.import_bits(rows[m], offs[m].astype(np.int64), clear=clear)
+            mutexlike = (
+                self.field_type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
+                and not clear
+            )
+            if segments is None or std is None or mutexlike:
+                shards = cols // width
+                offs = cols % width
+                segments = None
+            handles = []
+            for shard, seg_rows, seg_offs in (
+                segments
+                if segments is not None
+                else (
+                    (int(s), rows[shards == s], offs[shards == s])
+                    for s in np.unique(shards)
+                )
+            ):
+                if std is None:
+                    continue
+                frag = std.create_fragment_if_not_exists(int(shard))
+                if mutexlike:
+                    for r, c in zip(seg_rows, seg_offs):
+                        frag.set_mutex(int(r), int(c))
+                elif pipeline is not None:
+                    handles.append(
+                        self._submit_segment(
+                            pipeline, frag, seg_rows,
+                            seg_offs.astype(np.int64), clear,
+                        )
+                    )
+                else:
+                    frag.import_bits(
+                        seg_rows, seg_offs.astype(np.int64), clear=clear
+                    )
+            if handles:
+                pipeline.drain(handles)
             if timestamps is not None:
                 ts_arr = list(timestamps)
                 for i, ts in enumerate(ts_arr):
@@ -343,7 +380,24 @@ class Field:
                             int(rows[i]), int(cols[i])
                         )
 
-    def import_values(self, cols: Iterable[int], values: Iterable[int], clear: bool = False) -> None:
+    def _submit_segment(self, pipeline, frag, seg_rows, seg_cols, clear):
+        """One shard's merge as a pipeline segment: same-fragment
+        segments coalesce by key into ONE pool job (per-payload merges
+        inside it — summed "changed" matches a concat-then-merge, and
+        each merge sorts a modest batch), and the applied fragment is
+        handed to the device-upload stage once per group."""
+
+        def apply_group(payloads, _frag=frag):
+            changed = 0
+            for r, c in payloads:
+                changed += _frag.import_bits(r, c, clear=clear)
+            return changed, _frag
+
+        return pipeline.submit_segment(
+            (id(frag), bool(clear)), (seg_rows, seg_cols), apply_group
+        )
+
+    def import_values(self, cols: Iterable[int], values: Iterable[int], clear: bool = False, pipeline=None) -> None:
         self._check_bsi()
         cols = np.asarray(list(cols) if not isinstance(cols, np.ndarray) else cols, dtype=np.uint64)
         values = np.asarray(list(values) if not isinstance(values, np.ndarray) else values, dtype=np.int64)
@@ -356,15 +410,40 @@ class Field:
         width = self.n_words * 32
         shards = cols // width
         offs = cols % width
+        handles = []
         for shard in np.unique(shards):
             m = shards == shard
             frag = view.create_fragment_if_not_exists(int(shard))
-            frag.import_values(
-                offs[m].astype(np.int64),
-                (values[m] - self.base),
-                self.bit_depth,
-                clear=clear,
-            )
+            if pipeline is not None:
+                # BSI merges keep a unique key (no coalescing: duplicate
+                # columns across batches carry last-write-wins semantics
+                # that a concatenated group would reorder), but still
+                # drain shard-parallel with overlapped device uploads.
+                def apply_group(payloads, _frag=frag):
+                    [(c, v)] = payloads
+                    return (
+                        _frag.import_values(
+                            c, v, self.bit_depth, clear=clear
+                        ),
+                        _frag,
+                    )
+
+                handles.append(
+                    pipeline.submit_segment(
+                        object(),
+                        (offs[m].astype(np.int64), values[m] - self.base),
+                        apply_group,
+                    )
+                )
+            else:
+                frag.import_values(
+                    offs[m].astype(np.int64),
+                    (values[m] - self.base),
+                    self.bit_depth,
+                    clear=clear,
+                )
+        if handles:
+            pipeline.drain(handles)
 
     # -- schema -------------------------------------------------------------
 

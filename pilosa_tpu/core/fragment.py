@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.core import membudget
-from pilosa_tpu.ops import _hostops, bitops
+from pilosa_tpu.ops import _hostops, bitops, kernels
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WORDS
 
 # BSI row layout within a bsig_* view (reference fragment.go:90-96).
@@ -165,6 +165,10 @@ class Fragment:
         # set by the budget's evict callback when it could not take the
         # lock; honored at the next device sync
         self._evict_pending = False
+        # bytes shipped host->device by the most recent device_bits()
+        # sync (0 when the device copy was already current); the ingest
+        # uploader reads this for its overlap accounting
+        self.last_sync_h2d_bytes = 0
         self._delta_reset()
 
     def _set_host(self, arr: np.ndarray) -> None:
@@ -631,6 +635,7 @@ class Fragment:
                 native = _hostops.import_merge(
                     key, width, self.n_words, slots, row_ids,
                     self._host, clear, id_keys=True,
+                    want_wal=self.store is not None,
                 )
             if native is None:
                 inverse = np.searchsorted(row_ids, rows)
@@ -639,6 +644,7 @@ class Fragment:
                 native = _hostops.import_merge(
                     key, width, self.n_words, slots, row_ids,
                     self._host, clear,
+                    want_wal=self.store is not None,
                 )
             if native is not None:
                 n_changed, positions, per_row, changed_word_idx = native
@@ -812,6 +818,7 @@ class Fragment:
                 self._dirty.clear()
                 self._delta_reset()
             rebuilt = False
+            h2d = 0
             if self._device is None or self._device.shape[0] != self.capacity + 1:
                 padded = np.zeros((self.capacity + 1, self.n_words), dtype=np.uint32)
                 padded[: self.capacity] = self._host
@@ -819,6 +826,7 @@ class Fragment:
                 self._dirty.clear()
                 self._delta_reset()
                 rebuilt = True
+                h2d = padded.nbytes
             elif self._dirty:
                 # choose the cheapest transfer: changed words (8 B each),
                 # dirty rows (W*4 B each), or the full copy
@@ -856,6 +864,7 @@ class Fragment:
                     self._device = _scatter_words(
                         self._device, jnp.asarray(idx), jnp.asarray(vals)
                     )
+                    h2d = idx.nbytes + vals.nbytes
                 elif not prefer_full:
                     slots = np.fromiter(self._dirty, dtype=np.int32)
                     # Pad to a power-of-two bucket so the jitted scatter sees
@@ -870,14 +879,21 @@ class Fragment:
                         jnp.asarray(padded_slots),
                         jnp.asarray(self._host[padded_slots]),
                     )
+                    h2d = padded_slots.nbytes + (
+                        len(padded_slots) * self.n_words * 4
+                    )
                 else:
                     padded = np.zeros(
                         (self.capacity + 1, self.n_words), dtype=np.uint32
                     )
                     padded[: self.capacity] = self._host
                     self._device = jnp.asarray(padded)
+                    h2d = padded.nbytes
                 self._dirty.clear()
                 self._delta_reset()
+            self.last_sync_h2d_bytes = h2d
+            if h2d:
+                kernels.note_transfer(h2d, "h2d")
             self._account_device(rebuilt)
             return self._device
 

@@ -1,0 +1,301 @@
+"""Staged ingest pipeline: decode -> coalesced apply -> H2D upload.
+
+The lock-step import path serialized everything: decode a batch, merge
+it into the fragment's host mirror, (eventually) re-upload the fragment
+to HBM, repeat.  The pipeline runs the three stages concurrently over a
+stream of per-shard segments, tf.data-style (overlap the transfer with
+the compute):
+
+* **decode** — Roaring blob -> positions, natively and zero-copy into a
+  pinned staging buffer (staging.py).  Runs on the submitting handler
+  thread; bounded by the staging pool.
+* **apply** — the fragment merge, on the bounded ImportPool.  Every
+  segment is submitted before any is awaited, so distinct fragments
+  drain on different workers, and same-fragment segments group-commit
+  into one merged apply (importpool.submit_merged).
+* **upload** — the host->device sync of an applied fragment, on a
+  dedicated double-buffered uploader thread: while batch N+1 is being
+  merged on a worker, batch N's HBM upload is in flight here.  Two
+  slots (classic double buffering) bound the device-sync backlog; a
+  full slot queue blocks the apply stage, which blocks the pool queue,
+  which blocks the HTTP client — backpressure end to end.
+
+``overlap_frac`` reports the fraction of uploaded bytes whose transfer
+ran while an apply was in flight — the overlap the pipeline exists to
+create (kernels.py's h2d/d2h telemetry showed the lock-step path
+spending that time stalled).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from pilosa_tpu.ingest.staging import DEFAULT_CAPACITY, StagingPool
+
+
+class DeviceUploader:
+    """Double-buffered background host->device sync stage.
+
+    ``submit(frag)`` enqueues a fragment whose mirror was just mutated;
+    the uploader thread calls ``frag.device_bits()`` (the incremental
+    word/row-scatter sync) off the apply path.  The slot queue is the
+    double buffer: with the default two slots, one upload can be in
+    flight while one more is staged, and a third submission blocks its
+    apply worker (bounded backlog, propagated backpressure)."""
+
+    def __init__(self, slots: int = 2, stats=None, applies_active=None):
+        self.stats = stats
+        self._applies_active = applies_active or (lambda: 0)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, slots))
+        self.slots = max(1, slots)
+        self.uploads = 0
+        self.uploads_coalesced = 0
+        self.upload_errors = 0
+        self.h2d_bytes = 0
+        self.h2d_bytes_overlapped = 0
+        self.blocked_submits = 0
+        self.blocked_seconds = 0.0
+        self.upload_seconds = 0.0
+        self._pending = 0
+        self._queued: set[int] = set()  # id(frag) staged, not yet syncing
+        self._pending_lock = threading.Lock()
+        self._idle = threading.Condition(self._pending_lock)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ingest-upload", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, frag) -> None:
+        """Queue a fragment for device sync; blocks while both slots are
+        busy.  No-op after close (host mirror stays source of truth —
+        the next query's device_bits() syncs lazily).
+
+        Pending syncs coalesce: a fragment already staged (queued, sync
+        not yet started) absorbs this submission — device_bits() reads
+        the latest host state when it runs, so one sync covers every
+        apply that landed before it started.  Back-to-back merges into
+        one fragment cost ONE upload, not one per batch."""
+        if self._closed:
+            return
+        with self._pending_lock:
+            if id(frag) in self._queued:
+                self.uploads_coalesced += 1
+                if self.stats is not None:
+                    self.stats.count("ingest_uploads_coalesced", 1)
+                return
+            self._queued.add(id(frag))
+            self._pending += 1
+        try:
+            self._q.put_nowait(frag)
+            return
+        except queue.Full:
+            pass
+        self.blocked_submits += 1
+        t0 = time.perf_counter()
+        self._q.put(frag)
+        self.blocked_seconds += time.perf_counter() - t0
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted upload has completed."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            frag = self._q.get()
+            if frag is None:
+                return
+            # un-stage BEFORE syncing: an apply landing mid-sync must
+            # queue a fresh sync (device_bits only covers state that
+            # existed when it took the fragment lock)
+            with self._pending_lock:
+                self._queued.discard(id(frag))
+            overlapped = self._applies_active() > 0
+            t0 = time.perf_counter()
+            nbytes = 0
+            try:
+                frag.device_bits()
+                nbytes = int(getattr(frag, "last_sync_h2d_bytes", 0))
+            except Exception:
+                # Upload is an accelerator warm-path optimization; the
+                # host mirror stays authoritative and the next query
+                # syncs lazily, so a failed upload must not fail ingest.
+                self.upload_errors += 1
+                if self.stats is not None:
+                    self.stats.count("ingest_upload_errors", 1)
+            dt = time.perf_counter() - t0
+            # overlapped if an apply was running when the upload started
+            # or by the time it finished (the stages genuinely shared
+            # wall-clock either way)
+            overlapped = overlapped or self._applies_active() > 0
+            self.uploads += 1
+            self.upload_seconds += dt
+            self.h2d_bytes += nbytes
+            if overlapped:
+                self.h2d_bytes_overlapped += nbytes
+            if self.stats is not None:
+                self.stats.count("ingest_uploads", 1)
+                self.stats.count("ingest_h2d_bytes", nbytes)
+                if overlapped:
+                    self.stats.count("ingest_h2d_bytes_overlapped", nbytes)
+                self.stats.timing("ingest_upload", dt)
+            with self._idle:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.notify_all()
+
+    @property
+    def overlap_frac(self) -> float:
+        return (
+            self.h2d_bytes_overlapped / self.h2d_bytes if self.h2d_bytes else 0.0
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "slots": self.slots,
+            "uploads": self.uploads,
+            "uploadsCoalesced": self.uploads_coalesced,
+            "uploadErrors": self.upload_errors,
+            "h2dBytes": self.h2d_bytes,
+            "h2dBytesOverlapped": self.h2d_bytes_overlapped,
+            "overlapFrac": round(self.overlap_frac, 4),
+            "blockedSubmits": self.blocked_submits,
+            "blockedSeconds": round(self.blocked_seconds, 6),
+            "uploadSeconds": round(self.upload_seconds, 6),
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+
+class IngestPipeline:
+    """Orchestrates the staged import over an ImportPool.
+
+    The pipeline owns the staging pool (decode stage) and the device
+    uploader (transfer stage); the apply stage rides the shared
+    ImportPool.  API import paths feed it per-shard segments; each
+    segment's ``apply`` callback returns ``(result, fragment)`` and the
+    fragment (when not None) is handed to the uploader."""
+
+    def __init__(
+        self,
+        pool,
+        stats=None,
+        staging_buffers: int = 4,
+        staging_capacity: int = DEFAULT_CAPACITY,
+        upload_slots: int = 2,
+        upload: bool = True,
+    ):
+        self.pool = pool
+        self.stats = stats
+        self.staging = StagingPool(
+            buffers=staging_buffers, capacity=staging_capacity, stats=stats
+        )
+        self._applies = 0
+        self._applies_lock = threading.Lock()
+        self.uploader = (
+            DeviceUploader(
+                slots=upload_slots, stats=stats,
+                applies_active=self.applies_active,
+            )
+            if upload
+            else None
+        )
+        self.decoded = 0
+        self.decode_seconds = 0.0
+        self.segments = 0
+
+    def applies_active(self) -> int:
+        with self._applies_lock:
+            return self._applies
+
+    # -- stage 1: decode ------------------------------------------------------
+
+    def decode_roaring(self, data: bytes):
+        """Decode a Roaring blob into a staging buffer (zero-copy native
+        path); returns the held StagingBuffer.  The apply stage must
+        release it."""
+        self.pool.note_phase("decode")
+        buf = self.staging.acquire()
+        t0 = time.perf_counter()
+        try:
+            buf.decode_grow(data)
+        except BaseException:
+            buf.release()
+            raise
+        self.decode_seconds += time.perf_counter() - t0
+        self.decoded += 1
+        self.pool.advance(decoded=1)
+        return buf
+
+    # -- stage 2+3: coalesced apply, then upload ------------------------------
+
+    def submit_segment(self, key, payload, apply_group, release=None):
+        """Queue one per-shard segment for a (possibly coalesced) merged
+        apply.  ``apply_group(payloads)`` runs on a pool worker with the
+        arrival-ordered payload list of its group and returns
+        ``(result, fragment)``; the fragment is then submitted to the
+        upload stage.  ``release(payload)`` runs after the apply (even
+        on error) — staging buffers are returned here, so a failed drain
+        can't strand them."""
+        self.segments += 1
+
+        def fn_many(payloads):
+            self.pool.note_phase("apply")
+            with self._applies_lock:
+                self._applies += 1
+            try:
+                result, frag = apply_group(payloads)
+            finally:
+                with self._applies_lock:
+                    self._applies -= 1
+                if release is not None:
+                    for p in payloads:
+                        release(p)
+            self.pool.advance(applied=1)
+            if frag is not None and self.uploader is not None:
+                self.pool.note_phase("upload")
+                self.uploader.submit(frag)
+            return result
+
+        return self.pool.submit_merged(key, payload, fn_many)
+
+    def drain(self, handles):
+        """Await every submitted segment; first error raised after all
+        settle."""
+        self.pool.wait_all(handles)
+
+    @property
+    def overlap_frac(self) -> float:
+        return self.uploader.overlap_frac if self.uploader is not None else 0.0
+
+    def snapshot(self) -> dict:
+        out = {
+            "pool": self.pool.snapshot(),
+            "staging": self.staging.snapshot(),
+            "decoded": self.decoded,
+            "decodeSeconds": round(self.decode_seconds, 6),
+            "segments": self.segments,
+        }
+        if self.uploader is not None:
+            out["uploader"] = self.uploader.snapshot()
+            out["overlapFrac"] = round(self.overlap_frac, 4)
+        return out
+
+    def close(self) -> None:
+        if self.uploader is not None:
+            self.uploader.flush(timeout=5.0)
+            self.uploader.close()

@@ -1,0 +1,151 @@
+"""Pinned per-shard staging buffers for the ingest decode stage.
+
+A StagingPool owns a small, fixed set of reusable uint64 host buffers.
+The decode stage parks each Roaring blob's positions in one of them —
+through the native codec's ``rt_deserialize_into`` when available, so
+the decoded positions land straight in the reusable buffer with no
+intermediate malloc/copy pair per batch ("zero-copy" decode; the Python
+fallback pays one copy into the buffer and stays correct).
+
+The pool is deliberately bounded: ``acquire`` blocks when every buffer
+is out, which is the decode stage's backpressure (an import can decode
+at most ``buffers`` batches ahead of the apply stage).  Buffers are
+host-pinned in spirit — on a TPU host these numpy pages are what
+``jax.device_put`` DMA-reads, and keeping them alive and reused avoids
+both allocator churn and repinning.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from pilosa_tpu.storage import _native, roaring
+
+# Default buffer capacity in positions (8 bytes each).  Sized for one
+# bulk-import batch of a few hundred thousand bits; acquire() grows a
+# buffer in place when a bigger blob arrives, and the growth sticks for
+# the buffer's lifetime (steady state: no further allocation).
+DEFAULT_CAPACITY = 1 << 20
+
+
+class StagingBuffer:
+    """One reusable decode target.  ``positions`` is a view of the
+    filled prefix after ``decode``; ``release`` returns the buffer to
+    its pool (idempotent)."""
+
+    def __init__(self, pool: "StagingPool", capacity: int):
+        self._pool = pool
+        self.data = np.empty(capacity, dtype=np.uint64)
+        self.n = 0
+        self._held = False
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.size)
+
+    @property
+    def positions(self) -> np.ndarray:
+        return self.data[: self.n]
+
+    def ensure(self, capacity: int) -> None:
+        if self.data.size < capacity:
+            self.data = np.empty(int(capacity), dtype=np.uint64)
+
+    def decode(self, data: bytes) -> int:
+        """Decode a Roaring blob into this buffer; returns the position
+        count.  Raises roaring.RoaringError on a malformed payload."""
+        out = _native.deserialize_into(data, self.data)
+        if out is not None:
+            self.n = out[0]
+            return self.n
+        # Python fallback: decode then copy into the pinned buffer so
+        # downstream stages see one buffer type either way.
+        positions = roaring.deserialize(data)
+        self.ensure(positions.size)
+        self.data[: positions.size] = positions
+        self.n = int(positions.size)
+        return self.n
+
+    def decode_grow(self, data: bytes) -> int:
+        """``decode`` with the grow-and-retry loop for blobs bigger than
+        the buffer (native reports the required capacity)."""
+        try:
+            return self.decode(data)
+        except ValueError as e:
+            need = int(str(e).rsplit(" ", 1)[-1])
+            self.ensure(max(need, self.capacity * 2))
+            return self.decode(data)
+
+    def release(self) -> None:
+        self._pool._release(self)
+
+
+class StagingPool:
+    """Bounded pool of StagingBuffers; ``acquire`` blocks when empty."""
+
+    def __init__(
+        self,
+        buffers: int = 4,
+        capacity: int = DEFAULT_CAPACITY,
+        stats=None,
+    ):
+        self.size = max(1, int(buffers))
+        self.stats = stats
+        self._free: queue.Queue = queue.Queue(maxsize=self.size)
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self.acquires = 0
+        self.blocked_acquires = 0
+        self.blocked_seconds = 0.0
+        for _ in range(self.size):
+            self._free.put(StagingBuffer(self, int(capacity)))
+
+    def acquire(self, timeout: float | None = None) -> StagingBuffer:
+        """Take a buffer, blocking while all are out (decode-stage
+        backpressure).  Raises queue.Empty on timeout."""
+        try:
+            buf = self._free.get_nowait()
+        except queue.Empty:
+            self.blocked_acquires += 1
+            t0 = time.perf_counter()
+            buf = self._free.get(timeout=timeout)
+            dt = time.perf_counter() - t0
+            self.blocked_seconds += dt
+            if self.stats is not None:
+                self.stats.timing("ingest_staging_blocked", dt)
+        buf.n = 0
+        buf._held = True
+        with self._lock:
+            self._outstanding += 1
+        self.acquires += 1
+        if self.stats is not None:
+            self.stats.gauge("ingest_staging_outstanding", self.outstanding)
+        return buf
+
+    def _release(self, buf: StagingBuffer) -> None:
+        with self._lock:
+            if not buf._held:
+                return  # idempotent: error paths release defensively
+            buf._held = False
+            self._outstanding -= 1
+        self._free.put(buf)
+        if self.stats is not None:
+            self.stats.gauge("ingest_staging_outstanding", self.outstanding)
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def snapshot(self) -> dict:
+        return {
+            "buffers": self.size,
+            "outstanding": self.outstanding,
+            "acquires": self.acquires,
+            "blockedAcquires": self.blocked_acquires,
+            "blockedSeconds": round(self.blocked_seconds, 6),
+        }

@@ -159,7 +159,8 @@ def import_merge(
     mirror: np.ndarray,
     clear: bool,
     id_keys: bool = False,
-) -> tuple[int, np.ndarray, np.ndarray, np.ndarray] | None:
+    want_wal: bool = True,
+) -> tuple[int, np.ndarray | None, np.ndarray, np.ndarray] | None:
     """One native pass over SORTED keys (``row_index*width + col``, or
     ``row_id*width + col`` with ``id_keys=True``; duplicates allowed):
     apply the bulk set/clear to ``mirror`` (uint32 [capacity, n_words],
@@ -167,14 +168,18 @@ def import_merge(
     ``(n_changed, wal_positions, perrow_changed, changed_word_indices)``
     — everything Fragment.import_bits needs after the merge.  None when
     no native library is available (callers keep their numpy path).
-    The caller owns key bounds and holds the fragment lock."""
+    ``want_wal=False`` skips the WAL-position extraction (and its
+    keys.size allocation) — store-less fragments have no op log to
+    feed, and the ingest pipeline's merged applies make that array the
+    largest allocation of the whole pass.  The caller owns key bounds
+    and holds the fragment lock."""
     lib = load()
     if lib is None:
         return None
     keys = np.ascontiguousarray(keys, dtype=np.int64)
     slots = np.ascontiguousarray(slots, dtype=np.int64)
     row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
-    wal = np.empty(keys.size, dtype=np.uint64)
+    wal = np.empty(keys.size, dtype=np.uint64) if want_wal else None
     perrow = np.zeros(slots.size, dtype=np.int64)
     cw = np.empty(keys.size, dtype=np.int64)
     ncw = np.zeros(1, dtype=np.int64)
@@ -184,13 +189,13 @@ def import_merge(
             slots.ctypes.data_as(_I64P),
             row_ids.ctypes.data_as(_U64P), row_ids.size, int(id_keys),
             _u8(mirror), int(clear),
-            wal.ctypes.data_as(_U64P),
+            wal.ctypes.data_as(_U64P) if wal is not None else None,
             perrow.ctypes.data_as(_I64P),
             cw.ctypes.data_as(_I64P),
             ncw.ctypes.data_as(_I64P),
         )
     )
-    return nc, wal[:nc], perrow, cw[: int(ncw[0])]
+    return nc, wal[:nc] if wal is not None else None, perrow, cw[: int(ncw[0])]
 
 
 def pair_op(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:

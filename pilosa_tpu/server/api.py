@@ -74,6 +74,8 @@ class API:
         broadcaster=None,
         import_workers: int = 2,
         import_queue_depth: int = 16,
+        ingest_staging_buffers: int = 4,
+        ingest_upload_slots: int = 2,
         max_writes_per_request: int | None = None,
         batch_window: float = 0.002,
         batch_max_size: int = 64,
@@ -116,7 +118,18 @@ class API:
 
         self.import_pool = ImportPool(
             workers=import_workers, depth=import_queue_depth,
-            jobs=self.holder.jobs,
+            jobs=self.holder.jobs, stats=self.holder.stats,
+        )
+        # Staged ingest pipeline over the pool (pilosa_tpu/ingest/):
+        # zero-copy decode into staging buffers, sharded coalescing
+        # drains, double-buffered host->device uploads.
+        from pilosa_tpu.ingest import IngestPipeline
+
+        self.ingest = IngestPipeline(
+            self.import_pool,
+            stats=self.holder.stats,
+            staging_buffers=ingest_staging_buffers,
+            upload_slots=ingest_upload_slots,
         )
         # Continuous-batching serving plane (server/batcher.py):
         # concurrent read-only queries coalesce into micro-batched
@@ -414,11 +427,14 @@ class API:
 
         if not req.get("remote") and self._route_import(index, f, req, cols):
             return
-        # The local apply runs on the import worker pool (bounded queue,
-        # reference api.go:313-348); the handler blocks on completion.
-        self.import_pool.run(
-            lambda: self._apply_import(idx, f, index, field, req, cols)
-        )
+        # The local apply rides the staged ingest pipeline: per-shard
+        # segments are submitted to the bounded worker pool (reference
+        # api.go:313-348 backpressure semantics) before any is awaited,
+        # so distinct fragments drain concurrently while applied
+        # fragments upload to the device in the background.  One
+        # import-drain record spans the whole request.
+        with self.import_pool.drain_scope():
+            self._apply_import(idx, f, index, field, req, cols)
 
     def _apply_import(self, idx, f, index: str, field: str, req: dict, cols) -> None:
         translator = self.executor.translator
@@ -431,7 +447,10 @@ class API:
             lo, hi = int(values.min()) if len(values) else 0, int(values.max()) if len(values) else 0
             if len(values) and (lo < f.options.min or hi > f.options.max):
                 raise ApiError("value out of field range")
-            f.import_values(cols, values, clear=req.get("clear", False))
+            f.import_values(
+                cols, values, clear=req.get("clear", False),
+                pipeline=self.ingest,
+            )
         else:
             rows = req.get("rowIDs")
             if rows is None:
@@ -454,10 +473,15 @@ class API:
                 cols,
                 timestamps=ts,
                 clear=req.get("clear", False),
+                pipeline=self.ingest,
+                segments=req.get("_segments"),
             )
         ef = idx.existence_field()
         if ef is not None and not req.get("clear", False):
-            ef.import_bits(np.zeros(len(cols), dtype=np.uint64), cols)
+            ef.import_bits(
+                np.zeros(len(cols), dtype=np.uint64), cols,
+                pipeline=self.ingest,
+            )
 
     def _route_import(self, index: str, f, req: dict, cols: np.ndarray) -> bool:
         """Cluster import routing (reference api.go:964-995). Returns True
@@ -575,17 +599,62 @@ class API:
                     500,
                 )
             return {"changed": changed}
-        return self.import_pool.run(
-            lambda: self._apply_roaring(index, f, shard, data, clear, view)
-        )
+        # Staged local apply: zero-copy decode into a staging buffer on
+        # this handler thread, a coalesced merge on the import pool
+        # (queued same-fragment batches group-commit into one apply; the
+        # shared "changed" count is the group total), then a
+        # double-buffered device upload overlapping the next batch's
+        # merge.  One import-drain record spans the stages.
+        with self.import_pool.drain_scope():
+            try:
+                buf = self.ingest.decode_roaring(data)
+            except roaring.RoaringError as e:
+                raise ApiError(f"bad roaring payload: {e}")
+
+            def apply_group(payloads):
+                # Per-payload merges under ONE pool job: the summed
+                # "changed" equals the concat-then-merge count (a bit
+                # two payloads both set counts once — the second merge
+                # sees it already set), each merge sorts a modest batch
+                # instead of one huge concatenation, and the group
+                # still pays a single device sync.
+                changed = 0
+                frag = None
+                for b in payloads:
+                    result, frag = self._apply_roaring_positions(
+                        index, f, shard, b.positions, clear, view
+                    )
+                    changed += result["changed"]
+                return {"changed": changed}, frag
+
+            handle = self.ingest.submit_segment(
+                (index, f.name, view, int(shard), bool(clear)),
+                buf,
+                apply_group,
+                release=lambda b: b.release(),
+            )
+            return handle.wait()
 
     def _apply_roaring(self, index: str, f, shard: int, data: bytes, clear: bool, view: str) -> dict:
         """Local roaring apply, state-gate-free (also the landing path for
-        resize fragment transfers, which run while gated to RESIZING)."""
+        resize fragment transfers, which run while gated to RESIZING).
+        Lock-step variant: decode + apply on the calling thread."""
         try:
             positions = roaring.deserialize(data)
         except roaring.RoaringError as e:
             raise ApiError(f"bad roaring payload: {e}")
+        result, _frag = self._apply_roaring_positions(
+            index, f, shard, positions, clear, view
+        )
+        return result
+
+    def _apply_roaring_positions(
+        self, index: str, f, shard: int, positions: np.ndarray, clear: bool,
+        view: str,
+    ) -> tuple[dict, object]:
+        """Merge decoded roaring positions into the shard's fragment;
+        returns (result, fragment) so the pipeline can hand the applied
+        fragment to the device-upload stage."""
         width = f.n_words * 32
         rows = positions // np.uint64(width)
         cols_local = (positions % np.uint64(width)).astype(np.int64)
@@ -607,7 +676,7 @@ class API:
                 np.zeros(len(cols_local), dtype=np.uint64),
                 cols_local.astype(np.uint64) + np.uint64(shard) * np.uint64(width),
             )
-        return {"changed": int(changed)}
+        return {"changed": int(changed)}, frag
 
     # -- export (reference api.go:499-573 ExportCSV) ------------------------
 
@@ -1216,6 +1285,7 @@ class API:
     def close(self) -> None:
         if self.batcher is not None:
             self.batcher.close()  # drains the admission queue first
+        self.ingest.close()  # flush pending device uploads
         self.import_pool.close()
         if self.store is not None:
             self.store.close()

@@ -110,7 +110,7 @@ class QueryBatcher:
         self.stats = stats if hasattr(stats, "gauge") else None
         self.window = float(window)
         self.max_batch = int(max_batch)
-        self._q: queue.Queue = queue.Queue()
+        self._q: queue.Queue = queue.Queue()  # graftlint: disable=queue-discipline -- depth is bounded by the HTTP handler threads: each blocks on its own flight's result before submitting again
         self._lock = threading.Lock()
         self._closed = False
         self._depth = 0  # submitted, not yet demuxed (includes in-flight)

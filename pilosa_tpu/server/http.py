@@ -278,6 +278,11 @@ class Handler(BaseHTTPRequestHandler):
         if batcher is not None:
             # serving-plane block: queue depth, window knobs, flights
             snap["batcher"] = batcher.snapshot()
+        ingest = getattr(self.api, "ingest", None)
+        if ingest is not None:
+            # ingest-plane block: pool depth/inflight, staging occupancy,
+            # upload overlap — the pipeline's live tuning signals
+            snap["ingest"] = ingest.snapshot()
         self._send_json(200, snap)
 
     def r_debug_events(self):

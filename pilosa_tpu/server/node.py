@@ -47,6 +47,8 @@ class NodeServer:
         tls_ca_cert: str | None = None,
         import_workers: int = 2,
         import_queue_depth: int = 16,
+        ingest_staging_buffers: int = 4,
+        ingest_upload_slots: int = 2,
         max_writes_per_request: int | None = None,
         default_deadline: float = 0.0,
         client_timeout: float = 30.0,
@@ -105,6 +107,8 @@ class NodeServer:
             broadcaster=self.broadcaster,
             import_workers=import_workers,
             import_queue_depth=import_queue_depth,
+            ingest_staging_buffers=ingest_staging_buffers,
+            ingest_upload_slots=ingest_upload_slots,
             max_writes_per_request=max_writes_per_request,
             batch_window=batch_window,
             batch_max_size=batch_max_size,

@@ -29,6 +29,7 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
 _has_fnv = False  # set at load(): the symbol is absent from older .so builds
+_has_deser_into = False  # likewise (added with the ingest pipeline)
 
 
 def load() -> ctypes.CDLL | None:
@@ -73,6 +74,20 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.rt_popcount.restype = ctypes.c_uint64
+        global _has_deser_into
+        try:
+            lib.rt_deserialize_into.restype = ctypes.c_int
+            lib.rt_deserialize_into.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            _has_deser_into = True
+        except AttributeError:
+            _has_deser_into = False
         global _has_fnv
         try:
             lib.rt_fnv32a.argtypes = [
@@ -171,6 +186,39 @@ def deserialize(data: bytes) -> tuple[np.ndarray, int] | None:
     finally:
         lib.rt_free(out)
     return positions.astype(np.uint64), int(ops.value)
+
+
+def deserialize_into(
+    data: bytes, out: np.ndarray
+) -> tuple[int, int] | None:
+    """Decode ``data`` directly into the caller's uint64 buffer ``out``
+    (the staging-buffer zero-copy path: the input bytes are read in
+    place and the positions land in ``out`` with no intermediate
+    malloc/copy).  Returns (count, op_count); raises ValueError when
+    ``out`` is too small, with the required capacity in the message;
+    None on parse failure or when the library (or this symbol, in an
+    older prebuilt .so) is unavailable."""
+    lib = load()
+    if lib is None or not _has_deser_into:
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)  # zero-copy view
+    if not (out.dtype == np.uint64 and out.flags["C_CONTIGUOUS"]):
+        raise ValueError("staging buffer must be C-contiguous uint64")
+    out_n = ctypes.c_size_t()
+    ops = ctypes.c_uint64()
+    rc = lib.rt_deserialize_into(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        src.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        out.size,
+        ctypes.byref(out_n),
+        ctypes.byref(ops),
+    )
+    if rc == 3:
+        raise ValueError(f"staging buffer too small: need {out_n.value}")
+    if rc != 0:
+        return None
+    return int(out_n.value), int(ops.value)
 
 
 def popcount(data: bytes | np.ndarray) -> int | None:

@@ -217,17 +217,33 @@ class FragmentFile:
             return
         adds, self._batch_add = self._batch_add, []
         removes, self._batch_remove = self._batch_remove, []
+        # Group-commit: the whole batch — add AND remove records — lands
+        # in ONE locked append/flush (and one fsync under the "batch"
+        # WAL policy), so a pipeline-merged apply costs a single op-log
+        # write no matter how many imports coalesced into it.
+        records: list[bytes] = []
+        count = 0
         if adds:
-            self._emit_batch(roaring.OP_ADD_BATCH, np.concatenate(adds))
+            positions = np.concatenate(adds)
+            records += self._batch_records(roaring.OP_ADD_BATCH, positions)
+            count += len(positions)
         if removes:
-            self._emit_batch(roaring.OP_REMOVE_BATCH, np.concatenate(removes))
+            positions = np.concatenate(removes)
+            records += self._batch_records(roaring.OP_REMOVE_BATCH, positions)
+            count += len(positions)
+        if records:
+            self._append_many(records, count)
 
-    def _emit_batch(self, op_type: int, positions: np.ndarray) -> None:
-        records = [
+    def _batch_records(self, op_type: int, positions: np.ndarray) -> list[bytes]:
+        return [
             roaring.encode_op(op_type, positions[i : i + _BATCH_CHUNK])
             for i in range(0, len(positions), _BATCH_CHUNK)
         ]
-        self._append_many(records, len(positions))
+
+    def _emit_batch(self, op_type: int, positions: np.ndarray) -> None:
+        self._append_many(
+            self._batch_records(op_type, positions), len(positions)
+        )
 
     def log_add(self, row: int, col: int) -> None:
         pos = self._pos(row, col)

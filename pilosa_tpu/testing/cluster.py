@@ -27,10 +27,20 @@ class InProcessCluster:
         with_disk: bool = False,
         long_query_time: float = 0.0,
         slow_query_time: float = 0.0,
+        import_workers: int = 2,
+        import_queue_depth: int = 16,
+        ingest_staging_buffers: int = 4,
+        ingest_upload_slots: int = 2,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
         self._slow_query_time = slow_query_time
+        self._ingest_knobs = {
+            "import_workers": import_workers,
+            "import_queue_depth": import_queue_depth,
+            "ingest_staging_buffers": ingest_staging_buffers,
+            "ingest_upload_slots": ingest_upload_slots,
+        }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).
         self._next_node_num = n
@@ -42,6 +52,7 @@ class InProcessCluster:
                 n_words=n_words,
                 long_query_time=long_query_time,
                 slow_query_time=slow_query_time,
+                **self._ingest_knobs,
             )
             node.start()
             self.nodes.append(node)
@@ -114,6 +125,7 @@ class InProcessCluster:
             n_words=self.nodes[0].holder.n_words,
             long_query_time=self.nodes[0].server.httpd.RequestHandlerClass.long_query_time,
             slow_query_time=self._slow_query_time,
+            **self._ingest_knobs,
         )
         node.start()
         try:

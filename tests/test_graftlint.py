@@ -24,6 +24,7 @@ PER_FILE = [
     "timeout_discipline",
     "span_discipline",
     "log_discipline",
+    "queue_discipline",
 ]
 
 
@@ -101,6 +102,13 @@ class TestBadCorpusCoverage:
         assert "print() bypasses" in msgs
         assert "must take __name__" in msgs
         assert "inside a function" in msgs
+
+    def test_queue_classes(self):
+        msgs = " | ".join(self._msgs("queue_discipline"))
+        assert "defaults to maxsize=0" in msgs
+        assert "maxsize=0) is unbounded" in msgs
+        assert "maxsize=-1) is unbounded" in msgs
+        assert "SimpleQueue" in msgs
 
 
 class TestDispatchParity:

@@ -66,13 +66,15 @@ def test_api_import_goes_through_pool():
         api.create_index("i")
         api.create_field("i", "f")
         seen = []
-        orig = api.import_pool.run
+        orig = api.import_pool.submit
 
-        def spy(fn):
+        # The pipelined path submits per-shard segment jobs rather than
+        # one run() per request; everything still flows through submit.
+        def spy(fn, handle=None):
             seen.append(threading.current_thread().name)
-            return orig(fn)
+            return orig(fn, handle)
 
-        api.import_pool.run = spy
+        api.import_pool.submit = spy
         api.import_bits(
             "i", "f", {"rowIDs": [1, 1, 2], "columnIDs": [5, 9, 5]}
         )

@@ -1,0 +1,313 @@
+"""Staged ingest pipeline (pilosa_tpu/ingest/): zero-copy decode into
+staging buffers, coalesced group-commit applies on the bounded import
+pool, double-buffered device uploads — and the failure discipline the
+issue demands: backpressure at every stage (blocked submits, never an
+unbounded backlog), a faulted drain terminating its /debug/jobs record
+as ``error`` with the exception text, and no stranded staging buffers
+or jobs after an abort."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ingest import IngestPipeline, StagingBuffer, StagingPool
+from pilosa_tpu.obs.jobs import JobTracker
+from pilosa_tpu.obs.stats import MemStatsClient
+from pilosa_tpu.server.importpool import ImportPool
+from pilosa_tpu.storage import roaring
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+
+def _get(uri, path):
+    return json.load(urllib.request.urlopen(uri + path, timeout=10))
+
+
+def _post(uri, path, data, content_type="application/octet-stream"):
+    req = urllib.request.Request(
+        uri + path, data=data, headers={"Content-Type": content_type}
+    )
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+# -- staging buffers ----------------------------------------------------------
+
+
+def test_staging_decode_roundtrip_and_grow():
+    positions = np.array([1, 5, 70000, 70001, 2**33], dtype=np.uint64)
+    blob = roaring.serialize(positions)
+    buf = StagingPool(buffers=1, capacity=2).acquire()  # undersized:
+    buf.decode_grow(blob)  # decode_grow must resize and retry
+    assert np.array_equal(buf.positions, positions)
+    assert len(buf.data) >= len(positions)
+
+
+def test_staging_pool_releases_are_idempotent_and_bounded():
+    pool = StagingPool(buffers=2, capacity=16)
+    a = pool.acquire()
+    b = pool.acquire()
+    assert pool.outstanding == 2
+    a.release()
+    a.release()  # double-release must not free a second slot
+    assert pool.outstanding == 1
+    c = pool.acquire()  # reuses a's slot without blocking
+    assert pool.outstanding == 2
+    b.release()
+    c.release()
+    assert pool.outstanding == 0
+
+
+def test_staging_pool_blocks_when_exhausted():
+    pool = StagingPool(buffers=1, capacity=16)
+    held = pool.acquire()
+    got = []
+
+    def taker():
+        got.append(pool.acquire())
+
+    t = threading.Thread(target=taker, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not got, "acquire must block while every buffer is held"
+    held.release()
+    t.join(timeout=5)
+    assert got and pool.blocked_acquires >= 1
+    got[0].release()
+
+
+# -- backpressure through the pool -------------------------------------------
+
+
+def test_slow_apply_backpressure_blocks_submits():
+    """A slow drain stage must push back on the submitter: with a
+    depth-1 queue and one stalled worker, later submits block (and are
+    counted) instead of buffering an unbounded backlog."""
+    pool = ImportPool(workers=1, depth=1)
+    pipe = IngestPipeline(pool, staging_buffers=2, upload=False)
+    release = threading.Event()
+    applied = []
+
+    def apply_group(payloads):
+        release.wait(timeout=10)
+        applied.append(len(payloads))
+        return {"n": len(payloads)}, None
+
+    handles = []
+
+    def submit_all():
+        for i in range(6):
+            handles.append(
+                pipe.submit_segment(("k", i), i, apply_group)
+            )
+
+    t = threading.Thread(target=submit_all, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    # stalled worker + full queue: the submitting thread is blocked
+    assert t.is_alive(), "submitter should be blocked on the bounded queue"
+    assert pool._q.qsize() <= pool.depth
+    release.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    pipe.drain(handles)
+    assert pool.blocked_submits > 0
+    assert sum(applied) == 6
+    pipe.close()
+    pool.close()
+
+
+def test_same_key_submissions_coalesce_into_one_apply():
+    """While the single worker is stalled, same-key segments group-commit:
+    three submissions, ONE merged apply, everyone shares the result."""
+    pool = ImportPool(workers=1, depth=4)
+    pipe = IngestPipeline(pool, upload=False)
+    gate = threading.Event()
+    calls = []
+
+    def stall():
+        gate.wait(timeout=10)
+
+    pool.submit(stall)  # occupy the only worker
+
+    def apply_group(payloads):
+        calls.append(list(payloads))
+        return {"n": len(payloads)}, None
+
+    h1 = pipe.submit_segment("frag-key", "a", apply_group)
+    h2 = pipe.submit_segment("frag-key", "b", apply_group)
+    h3 = pipe.submit_segment("frag-key", "c", apply_group)
+    gate.set()
+    results = [h.wait() for h in (h1, h2, h3)]
+    assert calls == [["a", "b", "c"]], "expected ONE merged apply"
+    assert results == [{"n": 3}] * 3, "group result is shared by all members"
+    assert pool.jobs_coalesced == 2
+    pipe.close()
+    pool.close()
+
+
+def test_failing_drain_terminates_job_record_as_error():
+    """The satellite fix: a raising worker still decrements inflight and
+    the import-drain record finishes ``error`` with the exception text —
+    never a stranded active job."""
+    jobs = JobTracker()
+    pool = ImportPool(workers=1, depth=4, jobs=jobs)
+    pipe = IngestPipeline(pool, staging_buffers=2, upload=False)
+
+    def apply_group(payloads):
+        raise OSError("injected disk full")
+
+    buf = pipe.staging.acquire()
+    h = pipe.submit_segment("k", buf, apply_group, release=lambda b: b.release())
+    with pytest.raises(OSError):
+        h.wait()
+    # wait for the drain record to reach a terminal state
+    deadline = time.time() + 5
+    drains = []
+    while time.time() < deadline:
+        drains = [
+            j for j in jobs.snapshot()["jobs"] if j["kind"] == "import-drain"
+        ]
+        if drains and drains[-1]["status"] != "running":
+            break
+        time.sleep(0.01)
+    assert drains, "no import-drain record"
+    assert drains[-1]["status"] == "error"
+    assert "injected disk full" in (drains[-1]["error"] or "")
+    # nothing stranded: buffer released, no inflight work
+    assert pipe.staging.outstanding == 0
+    assert pool.snapshot()["inflight"] == 0
+    pipe.close()
+    pool.close()
+
+
+# -- live HTTP surface --------------------------------------------------------
+
+
+def test_http_bulk_import_pipeline_overlap_and_jobs():
+    """The acceptance scenario: a bulk import through the real HTTP path
+    shows overlapped H2D transfer, a terminal import-drain record with
+    per-stage phases, pilosa_ingest_* metrics, and an ``ingest`` block
+    in /debug/vars."""
+    with InProcessCluster(1) as cl:
+        cl.create_index("i")
+        cl.create_field("i", "f")
+        node = cl.nodes[0]
+        width = node.holder.n_words * 32
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            for shard in (0, 1):
+                positions = np.unique(
+                    rng.integers(0, width * 40, size=4000).astype(np.uint64)
+                )
+                _post(
+                    node.uri,
+                    f"/index/i/field/f/import-roaring/{shard}",
+                    roaring.serialize(positions),
+                )
+        snap = _get(node.uri, "/debug/vars")["ingest"]
+        assert snap["decoded"] >= 10
+        assert snap["uploader"]["uploads"] >= 1
+        assert snap["overlapFrac"] > 0, "no H2D/apply overlap measured"
+        assert snap["staging"]["outstanding"] == 0
+        assert snap["pool"]["inflight"] == 0
+        drains = [
+            j
+            for j in _get(node.uri, "/debug/jobs")["jobs"]
+            if j["kind"] == "import-drain"
+        ]
+        assert drains and all(d["status"] == "done" for d in drains)
+        # per-stage phases surfaced on the record
+        assert any(
+            d["phase"] in ("decode", "apply", "upload") for d in drains
+        )
+        assert any(d["progress"].get("decoded") for d in drains)
+        metrics = urllib.request.urlopen(
+            node.uri + "/metrics", timeout=10
+        ).read().decode()
+        assert "pilosa_ingest_uploads" in metrics
+        assert "pilosa_ingest_h2d_bytes" in metrics
+        # and the data actually landed: count bits through a query
+        res = cl.query(0, "i", "Count(Row(f=0))")
+        assert res["results"][0] >= 1
+
+
+def test_http_faulted_drain_bounded_and_error_terminal():
+    """disk_write_fail under a bulk import: the client sees the failure,
+    the drain record terminates ``error`` with the exception text, and
+    the pipeline strands nothing (no held staging buffers, no inflight
+    jobs — bounded memory, not a leak per retry)."""
+    with InProcessCluster(1, with_disk=True) as cl:
+        cl.create_index("i")
+        cl.create_field("i", "f")
+        node = cl.nodes[0]
+        cl.inject_fault("disk_write_fail", path="*/i/f/*")
+        # distinct positions per attempt: the op-log append (where the
+        # fault hooks) only runs when the apply changed bits
+        for k in range(3):
+            blob = roaring.serialize(
+                np.arange(k * 3000, (k + 1) * 3000, dtype=np.uint64)
+            )
+            with pytest.raises(urllib.error.HTTPError):
+                _post(node.uri, "/index/i/field/f/import-roaring/0", blob)
+        cl.clear_faults()
+        drains = [
+            j
+            for j in _get(node.uri, "/debug/jobs")["jobs"]
+            if j["kind"] == "import-drain"
+        ]
+        assert drains, "no import-drain record"
+        assert drains[-1]["status"] == "error"
+        assert "OSError" in (drains[-1]["error"] or "")
+        snap = _get(node.uri, "/debug/vars")["ingest"]
+        assert snap["staging"]["outstanding"] == 0
+        assert snap["pool"]["inflight"] == 0
+        assert snap["pool"]["errors"] >= 3
+        # recovery: a fresh import succeeds once the fault clears
+        fresh = np.arange(90000, 93000, dtype=np.uint64)
+        out = _post(
+            node.uri, "/index/i/field/f/import-roaring/0",
+            roaring.serialize(fresh),
+        )
+        assert out["changed"] == len(fresh)
+
+
+def test_http_slow_peer_import_still_drains():
+    """A slow replica (network fault) delays but does not wedge the
+    coordinator's drain; records still terminate and retries stay
+    bounded."""
+    with InProcessCluster(2, replica_n=2) as cl:
+        cl.create_index("i")
+        cl.create_field("i", "f")
+        cl.inject_fault("slow", node=1, route="*import*", delay=0.3, times=2)
+        t0 = time.time()
+        cl.import_bits("i", "f", [(1, 1), (1, 2), (2, 3)])
+        assert time.time() - t0 >= 0.25, "slow fault should have fired"
+        for node in cl.nodes:
+            snap = _get(node.uri, "/debug/vars").get("ingest")
+            assert snap is not None
+            assert snap["pool"]["inflight"] == 0
+            assert snap["staging"]["outstanding"] == 0
+        res = cl.query(0, "i", "Count(Row(f=1))")
+        assert res["results"][0] == 2
+
+
+def test_ingest_knobs_reach_the_pipeline():
+    with InProcessCluster(
+        1,
+        import_workers=3,
+        import_queue_depth=5,
+        ingest_staging_buffers=2,
+        ingest_upload_slots=1,
+    ) as cl:
+        api = cl.nodes[0].api
+        assert api.import_pool.workers == 3
+        assert api.import_pool.depth == 5
+        assert api.ingest.staging.size == 2
+        assert api.ingest.uploader.slots == 1
+        snap = _get(cl.nodes[0].uri, "/debug/vars")["ingest"]
+        assert snap["pool"]["workers"] == 3
+        assert snap["staging"]["buffers"] == 2

@@ -1,0 +1,27 @@
+"""Unbounded queues: every one of these buffers its backlog in RAM."""
+
+import queue
+
+
+def default_unbounded():
+    return queue.Queue()  # no maxsize -> maxsize=0
+
+
+def explicit_zero():
+    return queue.Queue(maxsize=0)
+
+
+def negative_positional():
+    return queue.Queue(-1)
+
+
+def lifo_unbounded():
+    return queue.LifoQueue()
+
+
+def priority_unbounded():
+    return queue.PriorityQueue(maxsize=0)
+
+
+def simple_never_bounded():
+    return queue.SimpleQueue()

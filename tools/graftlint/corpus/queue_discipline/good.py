@@ -1,0 +1,30 @@
+"""Bounded queues: backpressure propagates at every stage boundary."""
+
+import queue
+
+
+def bounded_literal():
+    return queue.Queue(maxsize=16)
+
+
+def bounded_positional():
+    return queue.Queue(8)
+
+
+def bounded_runtime_knob(depth):
+    # non-constant maxsize accepted: the max(1, ...) clamp is the tree's
+    # idiom for keeping a knob from disabling the bound
+    return queue.Queue(maxsize=max(1, depth))
+
+
+def lifo_bounded():
+    return queue.LifoQueue(maxsize=4)
+
+
+def priority_bounded(n):
+    return queue.PriorityQueue(maxsize=n)
+
+
+def kwargs_passthrough(**kw):
+    # maxsize may ride in **kw; the pass cannot see through it
+    return queue.Queue(**kw)

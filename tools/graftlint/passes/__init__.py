@@ -8,6 +8,7 @@ from tools.graftlint.passes import (
     exception_hygiene,
     lock_discipline,
     log_discipline,
+    queue_discipline,
     span_discipline,
     timeout_discipline,
     tpu_purity,
@@ -23,6 +24,7 @@ ALL_PASSES = [
     span_discipline,
     dispatch_parity,
     log_discipline,
+    queue_discipline,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

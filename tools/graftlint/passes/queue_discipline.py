@@ -1,0 +1,105 @@
+"""queue-discipline: producer/consumer queues must be bounded.
+
+Invariant: every stage boundary in this tree propagates backpressure.
+The ingest pipeline's whole design (ISSUE 7, docs/ingest.md) is a chain
+of bounded queues — staging pool -> import pool -> upload slots — so a
+slow disk or a slow device sync blocks the HTTP client instead of
+buffering the backlog in RAM.  One ``queue.Queue()`` with the default
+``maxsize=0`` silently breaks the chain: producers never block, memory
+grows with the backlog, and the first visible symptom is an OOM kill
+under exactly the load the bound was supposed to shed.
+
+Flag constructor sites of ``queue.Queue`` / ``LifoQueue`` /
+``PriorityQueue`` with no ``maxsize`` or a constant ``maxsize <= 0``,
+and ``queue.SimpleQueue`` always (it cannot be bounded).  A non-constant
+maxsize expression is accepted — ``Queue(maxsize=max(1, depth))`` is the
+idiom this tree uses to keep runtime knobs from disabling the bound.
+
+Scope: production code only.  Tests build throwaway queues with bounded
+element counts; ``tests/``, ``test_*.py`` and ``conftest.py`` are
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint._astutil import dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "queue-discipline"
+DESCRIPTION = "queue.Queue needs a positive maxsize (bounded backpressure); no SimpleQueue"
+
+_BOUNDABLE = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+def applies(path: str) -> bool:
+    p = path.replace("\\", "/")
+    name = p.rsplit("/", 1)[-1]
+    if "/tests/" in p or p.startswith("tests/"):
+        return False
+    return not (name.startswith("test_") or name == "conftest.py")
+
+
+def _call_target(node: ast.Call) -> str | None:
+    d = dotted(node.func)
+    if d is None:
+        return None
+    return d.rsplit(".", 1)[-1]
+
+
+def _maxsize_arg(node: ast.Call) -> ast.expr | None:
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            return kw.value
+    if node.args:
+        return node.args[0]
+    return None
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_target(node)
+        if name == "SimpleQueue":
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    "SimpleQueue cannot be bounded, so it cannot propagate "
+                    "backpressure; use queue.Queue(maxsize=N)",
+                )
+            )
+            continue
+        if name not in _BOUNDABLE:
+            continue
+        # **kwargs may carry a maxsize; the pass can't see through it
+        if any(kw.arg is None for kw in node.keywords):
+            continue
+        size = _maxsize_arg(node)
+        if size is None:
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    f"{name}() defaults to maxsize=0 (unbounded): producers "
+                    "never block and the backlog buffers in RAM; pass a "
+                    "positive maxsize",
+                )
+            )
+            continue
+        try:
+            # literal_eval folds -1 (UnaryOp) and similar constant forms
+            value = ast.literal_eval(size)
+        except (ValueError, SyntaxError):
+            continue  # runtime expression: assume the clamp idiom
+        if isinstance(value, int) and value <= 0:
+            findings.append(
+                Finding(
+                    path, node.lineno, node.col_offset, PASS_ID,
+                    f"{name}(maxsize={value}) is unbounded: a "
+                    "non-positive maxsize disables the bound; pass a "
+                    "positive maxsize",
+                )
+            )
+    return findings
