@@ -673,6 +673,58 @@ def main() -> None:
     # the real HTTP listener (one keep-alive connection per client)
     served_sweep = _served_concurrency_sweep()
 
+    # -- SLO harness lane: a short seeded mixed-workload burst through
+    # the full HTTP path with the server's error-budget tracker live
+    # (tools/loadharness.py is the long-form version; this lane pins the
+    # per-class p99 + budget burn numbers into the bench record, and
+    # best-effort writes the full SLO_r*.json next to BENCH_r*.json)
+    slo_lane = None
+    try:
+        from pilosa_tpu import loadgen
+
+        slo_report = loadgen.run_harness(
+            loadgen.WorkloadConfig(seed=42, n_cols=10_000),
+            [
+                loadgen.StageSpec("warm", 1.0, 60.0, 4),
+                loadgen.StageSpec("mix", 2.0, 120.0, 8),
+            ],
+            nodes=1,
+            cluster_kwargs={
+                "slo_burn_rules": [
+                    {"name": "fast", "long": 60.0, "short": 10.0,
+                     "factor": 14.4},
+                    {"name": "slow", "long": 300.0, "short": 60.0,
+                     "factor": 1.0},
+                ],
+                "slo_slot_seconds": 1.0,
+                "slo_latency_window": 60.0,
+            },
+            preload_bits=1024,
+        )
+        loadgen.validate_report(slo_report)
+        slo_lane = {
+            "throughput_ops_s": round(slo_report["throughputOpsPerSec"], 1),
+            "total_ops": slo_report["totalOps"],
+            "client_errors": slo_report["clientErrors"],
+            "pass": slo_report["pass"],
+            "fingerprint": slo_report["sequenceFingerprint"][:16],
+            "p99_ms": {
+                cls: round(c["p99Ms"], 2)
+                for cls, c in slo_report["ops"].items()
+                if c["p99Ms"] is not None
+            },
+        }
+        try:
+            slo_path = loadgen.next_report_path(".")
+            with open(slo_path, "w") as sf:
+                json.dump(slo_report, sf, indent=1, sort_keys=True)
+                sf.write("\n")
+            slo_lane["report_path"] = slo_path
+        except OSError as e:
+            print(f"warning: SLO report not written: {e}", file=sys.stderr)
+    except Exception as e:  # lane must never sink the bench
+        print(f"warning: slo harness lane failed: {e}", file=sys.stderr)
+
     # -- ingest: cold bulk import + sustained steady-state ------------------
     # Cold: one vectorized bulk import + HBM upload (fragment.import_bits).
     # Sustained: multi-batch run with the op-log store attached — each
@@ -1115,6 +1167,9 @@ def main() -> None:
         "served_http_sweep": served_sweep,
         "served_http_qps_1_client": served_sweep["levels"][0]["qps"],
         "served_http_qps_1k_clients": served_sweep["levels"][-1]["qps"],
+        # SLO harness lane (short seeded mixed burst; the full report is
+        # in the SLO_r*.json it writes — see docs/observability.md)
+        "slo_harness": slo_lane,
         "probe": _PROBE_ATTEMPTS,
         # dispatch-lane / compile-cache / transfer accounting for the
         # whole run: says WHICH lane produced the numbers above (a

@@ -27,9 +27,13 @@ class Holder:
         # way stats is.
         from pilosa_tpu.obs.events import EventJournal
         from pilosa_tpu.obs.jobs import JobTracker
+        from pilosa_tpu.obs.slo import SLOTracker
 
         self.events = EventJournal()
         self.jobs = JobTracker()
+        # SLO plane: per-op-class latency quantiles + error budgets,
+        # recorded at the HTTP boundary, served at /debug/slo.
+        self.slo = SLOTracker()
 
     def set_stats(self, client: stats_mod.StatsClient) -> None:
         """Install a stats client, re-tagging existing indexes/fields the

@@ -14,6 +14,31 @@ Columns translate per index; rows per (index, field).
 from __future__ import annotations
 
 import threading
+import time
+
+from pilosa_tpu.obs import stats as stats_mod
+
+# Process-global key-translation telemetry (the kernels.kernel_stats
+# pattern): visible in /metrics and /debug/vars even when the holder
+# runs a NopStatsClient.  Counters: translate_keys_created /
+# translate_keys_found / translate_ids_looked_up / translate_log_appends
+# (the last fed by storage/translatelog.py); histogram:
+# translate_lookup_seconds per translate_keys batch.
+translate_stats = stats_mod.MemStatsClient()
+
+
+def telemetry_snapshot() -> dict:
+    """Key-translation block for /debug/vars."""
+    snap = translate_stats.snapshot()
+    counters = snap["counters"]
+    hist = snap["histograms"].get("translate_lookup_seconds")
+    return {
+        "keysCreated": counters.get("translate_keys_created", 0),
+        "keysFound": counters.get("translate_keys_found", 0),
+        "idsLookedUp": counters.get("translate_ids_looked_up", 0),
+        "logAppends": counters.get("translate_log_appends", 0),
+        "lookup": hist,
+    }
 
 
 class TranslateStoreReadOnlyError(Exception):
@@ -47,6 +72,8 @@ class TranslateStore:
     def translate_keys(self, index: str, field: str, keys: list[str], create: bool = True) -> list[int]:
         """keys -> ids, allocating new ids as needed (reference
         translate.go TranslateColumnsToUint64 / TranslateRowsToUint64)."""
+        t0 = time.perf_counter()
+        created = 0
         with self._lock:
             ids, key_list = self._space(index, field)
             out = []
@@ -63,20 +90,32 @@ class TranslateStore:
                     id_ = len(key_list) + 1
                     ids[k] = id_
                     key_list.append(k)
+                    created += 1
                     self.log.append((index, field, k, id_))
                     if self.on_insert is not None:
                         self.on_insert(index, field, k, id_)
                 out.append(id_)
-            return out
+        # telemetry outside the store lock: a scrape mid-batch must not
+        # serialize against key allocation
+        if created:
+            translate_stats.count("translate_keys_created", created)
+        found = len(keys) - created
+        if found:
+            translate_stats.count("translate_keys_found", found)
+        translate_stats.timing("translate_lookup", time.perf_counter() - t0)
+        return out
 
     def translate_ids(self, index: str, field: str, id_list: list[int]) -> list[str]:
         """ids -> keys; unknown ids map to "" (reference
         TranslateColumnToString)."""
         with self._lock:
             _, key_list = self._space(index, field)
-            return [
+            out = [
                 key_list[i - 1] if 1 <= i <= len(key_list) else "" for i in id_list
             ]
+        if id_list:
+            translate_stats.count("translate_ids_looked_up", len(id_list))
+        return out
 
     def translate_key(self, index: str, field: str, key: str, create: bool = True) -> int:
         return self.translate_keys(index, field, [key], create=create)[0]

@@ -1,0 +1,45 @@
+"""Seeded, deterministic open-loop load generation (the SLO plane's
+workload half — see pilosa_tpu/obs/slo.py for the measurement half and
+tools/loadharness.py for the CLI)."""
+
+from pilosa_tpu.loadgen.harness import (
+    LoadHarness,
+    StageSpec,
+    prepare_schema,
+    preload,
+    run_harness,
+)
+from pilosa_tpu.loadgen.report import (
+    SCHEMA,
+    build_report,
+    next_report_path,
+    validate_report,
+)
+from pilosa_tpu.loadgen.workload import (
+    DEFAULT_MIX,
+    OP_CLASS,
+    Op,
+    WorkloadConfig,
+    WorkloadGenerator,
+    Zipf,
+    fingerprint,
+)
+
+__all__ = [
+    "DEFAULT_MIX",
+    "LoadHarness",
+    "OP_CLASS",
+    "Op",
+    "SCHEMA",
+    "StageSpec",
+    "WorkloadConfig",
+    "WorkloadGenerator",
+    "Zipf",
+    "build_report",
+    "fingerprint",
+    "next_report_path",
+    "prepare_schema",
+    "preload",
+    "run_harness",
+    "validate_report",
+]

@@ -1,0 +1,294 @@
+"""Open-loop load harness: drive a deterministic workload through the
+real HTTP path of an ``InProcessCluster`` and measure it against the
+server's SLO plane.
+
+Open-loop means arrivals are SCHEDULED, not request-response paced: op
+``k`` of a stage targeting ``rate`` ops/s is due at ``t0 + k/rate``
+regardless of how the previous op fared, and its latency is measured
+from its *scheduled* time — the standard defense against coordinated
+omission (a slow server can't slow the clock that judges it).  Workers
+pull due ops from a bounded queue; when every worker is wedged the
+dispatcher blocks on the queue and the lost schedule time is charged to
+the ops' latencies, not silently dropped.
+
+Stages ramp concurrency/rate and can override the op mix — the default
+stage plan in tools/loadharness.py includes a time-quantum-heavy stage
+(streaming timestamped SetBit with concurrent time-Range queries) and a
+full-mix ramp.  Faults (testing/faults.py) can be injected for
+error-budget exercises.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import math
+import queue
+import threading
+import time
+import urllib.parse
+
+from pilosa_tpu.loadgen import report as report_mod
+from pilosa_tpu.loadgen.workload import (
+    WorkloadConfig,
+    WorkloadGenerator,
+    fingerprint,
+    schema_ops,
+)
+
+logger = logging.getLogger(__name__)
+
+_HTTP_TIMEOUT = 30.0
+
+
+class StageSpec:
+    """One load stage: ``rate`` ops/s for ``duration`` seconds across
+    ``workers`` concurrent connections, drawing kinds from ``mix``
+    (None = the workload config's mix)."""
+
+    def __init__(
+        self,
+        name: str,
+        duration: float,
+        rate: float,
+        workers: int,
+        mix: dict[str, float] | None = None,
+    ):
+        self.name = name
+        self.duration = float(duration)
+        self.rate = float(rate)
+        self.workers = int(workers)
+        self.mix = mix
+
+    @property
+    def op_count(self) -> int:
+        return max(1, int(math.ceil(self.duration * self.rate)))
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration": self.duration,
+            "rate": self.rate,
+            "workers": self.workers,
+            "mix": self.mix,
+        }
+
+
+def prepare_schema(cluster, config: WorkloadConfig) -> None:
+    """Create the workload's indexes/fields through the API (idempotent
+    across harness reruns on one cluster)."""
+    from pilosa_tpu.server.api import ConflictError
+
+    for kind, name, options in schema_ops(config):
+        try:
+            if kind == "index":
+                cluster.create_index(name, options)
+            else:
+                index, _, field = name.partition("/")
+                cluster.create_field(index, field, options)
+        except ConflictError:
+            pass
+
+
+def preload(cluster, config: WorkloadConfig, bits: int = 4096) -> None:
+    """Deterministic seed data so reads have something to find: zipfian
+    (row, col) pairs into the segmentation field."""
+    import numpy as np
+
+    from pilosa_tpu.loadgen.workload import Zipf
+
+    rng = np.random.default_rng(config.seed ^ 0x5EED)
+    rz = Zipf(config.n_rows, config.zipf_theta)
+    cz = Zipf(config.n_cols, config.zipf_theta)
+    pairs = [(rz.sample(rng), cz.sample(rng)) for _ in range(bits)]
+    cluster.import_bits(config.index, "seg", pairs)
+
+
+class _WorkerResult:
+    __slots__ = ("records", "client_errors")
+
+    def __init__(self):
+        # (op_class, latency_s, service_s, ok, status)
+        self.records: list[tuple[str, float, float, bool, int]] = []
+        self.client_errors = 0
+
+
+def _worker(
+    base: str,
+    q: "queue.Queue",
+    out: _WorkerResult,
+    stop: threading.Event,
+) -> None:
+    netloc = urllib.parse.urlsplit(base).netloc
+    conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
+    try:
+        while not stop.is_set():
+            item = q.get()
+            if item is None:
+                return
+            op, sched = item
+            now = time.monotonic()
+            if sched > now:
+                time.sleep(sched - now)
+            t_start = time.monotonic()
+            status = 0
+            try:
+                conn.request(
+                    op.method,
+                    op.path,
+                    body=op.body,
+                    headers={"Content-Type": op.ctype},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except (OSError, http.client.HTTPException):
+                # connection-level failure: count it, reconnect, move on
+                out.client_errors += 1
+                conn.close()
+                conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
+            done = time.monotonic()
+            ok = 200 <= status < 400
+            out.records.append(
+                (op.op_class, done - sched, done - t_start, ok, status)
+            )
+    finally:
+        conn.close()
+
+
+def _fetch_json(base: str, path: str) -> dict | None:
+    netloc = urllib.parse.urlsplit(base).netloc
+    conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            return None
+        return json.loads(body)
+    except (OSError, ValueError, http.client.HTTPException):
+        return None
+    finally:
+        conn.close()
+
+
+def _fetch_text(base: str, path: str) -> str:
+    netloc = urllib.parse.urlsplit(base).netloc
+    conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.read().decode("utf-8", "replace")
+    except (OSError, http.client.HTTPException):
+        return ""
+    finally:
+        conn.close()
+
+
+class LoadHarness:
+    """Runs staged open-loop load against cluster node URIs and builds
+    the SLO report dict (see loadgen/report.py for the schema)."""
+
+    def __init__(
+        self,
+        uris: list[str],
+        config: WorkloadConfig,
+        stages: list[StageSpec],
+    ):
+        if not uris:
+            raise ValueError("at least one node URI required")
+        self.uris = list(uris)
+        self.config = config
+        self.stages = list(stages)
+
+    def generate(self) -> list[list]:
+        """Pre-generate every stage's op sequence (the full request
+        sequence is fixed before the first byte hits the wire); one
+        generator stream spans the stages so the whole run replays from
+        the seed."""
+        gen = WorkloadGenerator(self.config)
+        return [gen.sequence(st.op_count, st.mix) for st in self.stages]
+
+    def run(self) -> dict:
+        per_stage_ops = self.generate()
+        all_ops = [op for ops in per_stage_ops for op in ops]
+        seq_fp = fingerprint(all_ops)
+        live_snapshot = None
+        results: list[_WorkerResult] = []
+        stage_meta = []
+        t_run0 = time.monotonic()
+        for si, (stage, ops) in enumerate(zip(self.stages, per_stage_ops)):
+            stop = threading.Event()
+            q: "queue.Queue" = queue.Queue(maxsize=max(64, stage.workers * 8))
+            outs = [_WorkerResult() for _ in range(stage.workers)]
+            threads = [
+                threading.Thread(
+                    target=_worker,
+                    args=(self.uris[w % len(self.uris)], q, outs[w], stop),
+                    name=f"loadgen-{stage.name}-{w}",
+                    daemon=True,
+                )
+                for w in range(stage.workers)
+            ]
+            for t in threads:
+                t.start()
+            t0 = time.monotonic()
+            interval = 1.0 / stage.rate if stage.rate > 0 else 0.0
+            for k, op in enumerate(ops):
+                q.put((op, t0 + k * interval))
+            for _ in threads:
+                q.put(None)
+            # mid-run liveness probe: /debug/slo must serve DURING load
+            if si == 0:
+                live_snapshot = _fetch_json(self.uris[0], "/debug/slo")
+            for t in threads:
+                t.join()
+            stop.set()
+            results.extend(outs)
+            stage_meta.append(
+                {**stage.to_dict(), "ops": len(ops)}
+            )
+        wall = time.monotonic() - t_run0
+        records = [r for out in results for r in out.records]
+        client_errors = sum(out.client_errors for out in results)
+        server_slo = _fetch_json(self.uris[0], "/debug/slo")
+        metrics_text = _fetch_text(self.uris[0], "/metrics")
+        return report_mod.build_report(
+            config=self.config.to_dict(),
+            stages=stage_meta,
+            records=records,
+            client_errors=client_errors,
+            wall_seconds=wall,
+            sequence_fingerprint=seq_fp,
+            server_slo=server_slo,
+            live_slo_ok=bool(live_snapshot and live_snapshot.get("classes") is not None),
+            slo_metrics_present="pilosa_slo_requests_total" in metrics_text,
+        )
+
+
+def run_harness(
+    config: WorkloadConfig,
+    stages: list[StageSpec],
+    nodes: int = 1,
+    cluster_kwargs: dict | None = None,
+    faults: list[dict] | None = None,
+    preload_bits: int = 4096,
+) -> dict:
+    """Boot an InProcessCluster, prepare schema + seed data, drive the
+    staged workload, and return the report dict.  ``cluster_kwargs``
+    passes through to InProcessCluster (SLO window knobs etc.);
+    ``faults`` is a list of ``inject_fault`` kwargs dicts."""
+    from pilosa_tpu.testing.cluster import InProcessCluster
+
+    kwargs = dict(cluster_kwargs or {})
+    with InProcessCluster(nodes, **kwargs) as cluster:
+        prepare_schema(cluster, config)
+        if preload_bits:
+            preload(cluster, config, preload_bits)
+        for f in faults or []:
+            cluster.inject_fault(**f)
+        harness = LoadHarness(
+            [n.uri for n in cluster.nodes], config, stages
+        )
+        return harness.run()

@@ -72,9 +72,13 @@ class NopStatsClient(StatsClient):
 NOP = NopStatsClient()
 
 
-# Prometheus-style cumulative bucket bounds.  Log-spaced seconds: wide
-# enough for sub-ms kernel launches and multi-second cluster queries.
+# Prometheus-style cumulative bucket bounds.  Log-spaced seconds: the
+# sub-ms bounds (50/100/250/500 µs) resolve the measured serving-cache
+# floor of 0.07-0.16 ms/op (BENCH_r05) — without them every read-path
+# latency collapses into the first bucket and p999 is meaningless — and
+# the top end still covers multi-second cluster queries.
 HISTOGRAM_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005,
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
@@ -102,14 +106,18 @@ class _Histo:
                 self.buckets[i] += 1
 
     def to_dict(self) -> dict:
+        buckets = {
+            str(b): c for b, c in zip(HISTOGRAM_BUCKETS, self.buckets)
+        }
+        # Cumulative +Inf bucket: observations above the largest bound
+        # land only here, so the bucket map always sums to count.
+        buckets["+Inf"] = self.count
         return {
             "count": self.count,
             "sum": self.total,
             "min": self.min if self.count else None,
             "max": self.max if self.count else None,
-            "buckets": {
-                str(b): c for b, c in zip(HISTOGRAM_BUCKETS, self.buckets)
-            },
+            "buckets": buckets,
         }
 
 

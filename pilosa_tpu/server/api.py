@@ -18,7 +18,7 @@ import numpy as np
 
 from pilosa_tpu import __version__, deadline
 from pilosa_tpu.obs import events as ev
-from pilosa_tpu.obs import qprofile
+from pilosa_tpu.obs import qprofile, slo
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core import timequantum
@@ -213,6 +213,11 @@ class API:
         deadline.check(f"query on {index!r}")
         from pilosa_tpu.pql import ParseError
 
+        if remote:
+            # node↔node fan-out sub-query: the user-facing request is
+            # already on the coordinator's budget — don't double-count
+            # it against a read class on this node.
+            slo.note_class(slo.OP_INTERNAL)
         prof = None
         if profile or self.slow_queries.enabled:
             node_id = getattr(self.cluster, "node_id", "") if self.cluster else ""
@@ -254,16 +259,17 @@ class API:
         executor batches per-hop itself (ROADMAP item 4)."""
         from pilosa_tpu import pql
 
+        q = pql.parse(pql_text) if isinstance(pql_text, str) else pql_text
+        # SLO op class rides a contextvar to the HTTP layer's recording
+        # point (this thread handles the whole request).
+        slo.note_class(slo.classify_query(q))
         batcher = self.batcher
         single = self.dist is None or self.dist._single
-        if batcher is not None and single:
-            q = pql.parse(pql_text)
-            if batcher.accepts(q):
-                return batcher.submit(index, q, shards=shards)
-            pql_text = q  # already parsed; don't parse twice below
+        if batcher is not None and single and batcher.accepts(q):
+            return batcher.submit(index, q, shards=shards)
         if self.dist is not None:
-            return self.dist.execute(index, pql_text, shards=shards)
-        return self.executor.execute(index, pql_text, shards=shards)
+            return self.dist.execute(index, q, shards=shards)
+        return self.executor.execute(index, q, shards=shards)
 
     # -- schema CRUD (reference api.go:161-495) -----------------------------
 
@@ -930,6 +936,10 @@ class API:
     def jobs_snapshot(self, kind: str | None = None) -> dict:
         """Background-job records (active + bounded history)."""
         return self.holder.jobs.snapshot(kind)
+
+    def slo_snapshot(self) -> dict:
+        """Live per-op-class objective state (/debug/slo)."""
+        return self.holder.slo.snapshot()
 
     def fragment_details(
         self, index: str | None = None, field: str | None = None

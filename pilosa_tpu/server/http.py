@@ -35,10 +35,21 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
-from pilosa_tpu.obs import tracing
+from pilosa_tpu.obs import slo, tracing
 from pilosa_tpu.server.api import API, ApiError
 
 logger = logging.getLogger(__name__)
+
+# SLO op class by route, for routes whose class is knowable from the
+# path alone; query routes are classified by the API layer (it has the
+# parsed call tree) via slo.note_class, which takes precedence.
+_SLO_ROUTE_CLASS = {
+    "query": slo.OP_READ_OTHER,
+    "import_": slo.OP_IMPORT,
+    "import_roaring": slo.OP_IMPORT,
+    "translate_keys": slo.OP_TRANSLATE,
+    "translate_ids": slo.OP_TRANSLATE,
+}
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/$"), "root"),
@@ -49,6 +60,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/slo$"), "debug_slo"),
     ("GET", re.compile(r"^/debug/slow-queries$"), "debug_slow_queries"),
     ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
@@ -166,6 +178,10 @@ class Handler(BaseHTTPRequestHandler):
                 parent = tracing.get_tracer().extract_headers(self.headers)
                 span = tracing.start_span(f"http.{name}", child_of=parent)
                 span.set_tag("method", method).set_tag("path", parsed.path)
+                # Error budget: server-attributed failures only.  504s
+                # (deadline/batcher expiry) and 500s burn budget; 4xx
+                # client mistakes don't.
+                slo_error = False
                 try:
                     with deadline.scope(self._request_budget()), span:
                         getattr(self, "r_" + name)(**match.groupdict())
@@ -173,19 +189,26 @@ class Handler(BaseHTTPRequestHandler):
                     # Distinct from ApiError (400-family): a spent budget
                     # is a timeout, not a client mistake (reference maps
                     # context.DeadlineExceeded similarly).
+                    slo_error = True
                     self.api.holder.stats.count(
                         "http_deadline_exceeded", 1, 1.0
                     )
                     self._send_json(504, {"error": f"deadline exceeded: {e}"})
                 except ApiError as e:
+                    slo_error = e.code >= 500
                     self._send_json(e.code, {"error": str(e)})
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # internal error
+                    slo_error = True
                     logger.exception("internal error")
                     self._send_json(500, {"error": f"internal: {e}"})
                 finally:
                     elapsed = time.monotonic() - t0
+                    op_class = slo.take_class() or _SLO_ROUTE_CLASS.get(
+                        name, slo.OP_OTHER
+                    )
+                    self.api.holder.slo.observe(op_class, elapsed, slo_error)
                     self.api.holder.stats.count_with_tags(
                         "http_requests", 1, 1.0, (f"route:{name}",)
                     )
@@ -229,7 +252,7 @@ class Handler(BaseHTTPRequestHandler):
         registry (ops/kernels.kernel_stats) so it is visible even when
         the holder uses a NopStatsClient; both registries are rendered
         into the one scrape."""
-        from pilosa_tpu.core import membudget
+        from pilosa_tpu.core import membudget, translate
         from pilosa_tpu.obs.stats import prometheus_text
         from pilosa_tpu.ops import kernels
 
@@ -242,8 +265,14 @@ class Handler(BaseHTTPRequestHandler):
             stats.gauge("device_cap_bytes", dev["capBytes"] or 0)
             stats.gauge("device_entries", dev["entries"])
             stats.gauge("device_evictions", dev["evictions"])
-        text = prometheus_text(self.api.holder.stats) + prometheus_text(
-            kernels.kernel_stats
+        # Kernel + key-translation telemetry live in process-global
+        # registries (visible under NopStatsClient holders); the SLO
+        # plane renders its own pilosa_slo_* series from the tracker.
+        text = (
+            prometheus_text(self.api.holder.stats)
+            + prometheus_text(kernels.kernel_stats)
+            + prometheus_text(translate.translate_stats)
+            + self.api.holder.slo.prometheus_text()
         )
         self._send(
             200,
@@ -268,12 +297,14 @@ class Handler(BaseHTTPRequestHandler):
                 "stack_incremental": ex.stack_incremental,
                 "bsi_stack_launches": ex.bsi_stack_launches,
             }
-        from pilosa_tpu.core import membudget
+        from pilosa_tpu.core import membudget, translate
         from pilosa_tpu.ops import kernels
 
         snap["kernels"] = kernels.telemetry_snapshot()
         snap["device"] = membudget.default_budget().snapshot()
         snap["events"] = self.api.holder.events.snapshot_summary()
+        snap["slo"] = self.api.holder.slo.summary()
+        snap["translate"] = translate.telemetry_snapshot()
         batcher = getattr(self.api, "batcher", None)
         if batcher is not None:
             # serving-plane block: queue depth, window knobs, flights
@@ -284,6 +315,11 @@ class Handler(BaseHTTPRequestHandler):
             # upload overlap — the pipeline's live tuning signals
             snap["ingest"] = ingest.snapshot()
         self._send_json(200, snap)
+
+    def r_debug_slo(self):
+        """Live SLO state: per-op-class latency quantiles, windowed
+        availability, burn rates, alert firing, pass/fail verdicts."""
+        self._send_json(200, self.api.slo_snapshot())
 
     def r_debug_events(self):
         """Event journal past ?since=<seq> (gap-free cursor resume);

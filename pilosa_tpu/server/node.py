@@ -58,6 +58,10 @@ class NodeServer:
         slow_query_time: float = 0.0,
         batch_window: float = 0.002,
         batch_max_size: int = 64,
+        slo_objectives: dict | None = None,
+        slo_burn_rules: list[dict] | None = None,
+        slo_slot_seconds: float | None = None,
+        slo_latency_window: float | None = None,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -69,6 +73,41 @@ class NodeServer:
         self.holder.set_stats(
             stats_client if stats_client is not None else MemStatsClient()
         )
+        # SLO-plane knobs: override the holder's default tracker when any
+        # is set (tests/load harness shrink windows so burn behavior is
+        # observable in seconds, not days).
+        if (
+            slo_objectives is not None
+            or slo_burn_rules is not None
+            or slo_slot_seconds is not None
+            or slo_latency_window is not None
+        ):
+            from pilosa_tpu.obs import slo as slo_mod
+
+            rules = None
+            if slo_burn_rules is not None:
+                rules = tuple(
+                    slo_mod.BurnRule(
+                        r["name"], r["long"], r["short"], r["factor"]
+                    )
+                    for r in slo_burn_rules
+                )
+            self.holder.slo = slo_mod.SLOTracker(
+                objectives=(
+                    slo_mod.objectives_from_dict(slo_objectives)
+                    if slo_objectives is not None
+                    else None
+                ),
+                burn_rules=rules,
+                slot_seconds=(
+                    slo_slot_seconds if slo_slot_seconds is not None else 5.0
+                ),
+                latency_window=(
+                    slo_latency_window
+                    if slo_latency_window is not None
+                    else 300.0
+                ),
+            )
         self.store = None
         if data_dir is not None:
             self.store = HolderStore(self.holder, data_dir)

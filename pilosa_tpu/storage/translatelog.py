@@ -20,6 +20,7 @@ import os
 import struct
 import threading
 
+from pilosa_tpu.core import translate
 from pilosa_tpu.core.translate import TranslateStore
 
 MAGIC = 0x504B4C31
@@ -95,6 +96,8 @@ class TranslateLog:
     def _append(self, index: str, field: str, key: str, id_: int) -> None:
         ib, fb, kb = index.encode(), field.encode(), key.encode()
         rec = _REC.pack(REC_INSERT, len(ib), len(fb), len(kb), id_) + ib + fb + kb
+        # counted before the file lock: telemetry never queues behind I/O
+        translate.translate_stats.count("translate_log_appends", 1)
         with self._lock:
             if self._f is None:
                 return

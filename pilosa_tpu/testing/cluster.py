@@ -31,6 +31,11 @@ class InProcessCluster:
         import_queue_depth: int = 16,
         ingest_staging_buffers: int = 4,
         ingest_upload_slots: int = 2,
+        slo_objectives: dict | None = None,
+        slo_burn_rules: list[dict] | None = None,
+        slo_slot_seconds: float | None = None,
+        slo_latency_window: float | None = None,
+        default_deadline: float = 0.0,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -40,6 +45,11 @@ class InProcessCluster:
             "import_queue_depth": import_queue_depth,
             "ingest_staging_buffers": ingest_staging_buffers,
             "ingest_upload_slots": ingest_upload_slots,
+            "slo_objectives": slo_objectives,
+            "slo_burn_rules": slo_burn_rules,
+            "slo_slot_seconds": slo_slot_seconds,
+            "slo_latency_window": slo_latency_window,
+            "default_deadline": default_deadline,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

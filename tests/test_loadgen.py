@@ -1,0 +1,212 @@
+"""Load-generation plane (pilosa_tpu/loadgen/): seed determinism of the
+workload generator (the property that makes SLO_r*.json reports
+reproducible), zipfian access skew, report schema construction and
+validation, and one short end-to-end harness run against a real
+cluster."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.loadgen import (
+    DEFAULT_MIX,
+    OP_CLASS,
+    LoadHarness,
+    StageSpec,
+    WorkloadConfig,
+    WorkloadGenerator,
+    Zipf,
+    build_report,
+    fingerprint,
+    next_report_path,
+    run_harness,
+    validate_report,
+)
+
+# -- determinism --------------------------------------------------------------
+
+
+def test_same_seed_replays_identical_sequence():
+    a = WorkloadGenerator(WorkloadConfig(seed=9)).sequence(300)
+    b = WorkloadGenerator(WorkloadConfig(seed=9)).sequence(300)
+    assert fingerprint(a) == fingerprint(b)
+    for x, y in zip(a, b):
+        assert (x.kind, x.method, x.path, x.body) == (
+            y.kind, y.method, y.path, y.body
+        )
+
+
+def test_different_seeds_diverge():
+    a = WorkloadGenerator(WorkloadConfig(seed=9)).sequence(300)
+    b = WorkloadGenerator(WorkloadConfig(seed=10)).sequence(300)
+    assert fingerprint(a) != fingerprint(b)
+
+
+def test_generator_stream_spans_stages():
+    # consecutive sequence() calls continue one rng stream: the pair of
+    # stages replays as a whole, and the stages are not identical
+    g1 = WorkloadGenerator(WorkloadConfig(seed=5))
+    s1, s2 = g1.sequence(100), g1.sequence(100)
+    g2 = WorkloadGenerator(WorkloadConfig(seed=5))
+    t1, t2 = g2.sequence(100), g2.sequence(100)
+    assert fingerprint(s1) == fingerprint(t1)
+    assert fingerprint(s2) == fingerprint(t2)
+    assert fingerprint(s1) != fingerprint(s2)
+
+
+def test_harness_generate_is_deterministic():
+    cfg = WorkloadConfig(seed=3)
+    stages = [StageSpec("a", 1.0, 50.0, 2), StageSpec("b", 1.0, 80.0, 4)]
+    h1 = LoadHarness(["http://x"], cfg, stages).generate()
+    h2 = LoadHarness(["http://x"], cfg, stages).generate()
+    flat1 = [op for ops in h1 for op in ops]
+    flat2 = [op for ops in h2 for op in ops]
+    assert fingerprint(flat1) == fingerprint(flat2)
+
+
+# -- workload shape -----------------------------------------------------------
+
+
+def test_mix_restricts_kinds_and_maps_classes():
+    g = WorkloadGenerator(WorkloadConfig(seed=1))
+    ops = g.sequence(200, mix={"count": 1.0, "set_tq": 1.0})
+    kinds = {op.kind for op in ops}
+    assert kinds <= {"count", "set_tq"}
+    assert len(kinds) == 2  # 200 draws at 50/50 hit both
+    for op in ops:
+        assert op.op_class == OP_CLASS[op.kind]
+
+
+def test_default_mix_covers_every_op_class_family():
+    assert set(DEFAULT_MIX) == set(OP_CLASS)
+    classes = {OP_CLASS[k] for k in DEFAULT_MIX}
+    assert {"write", "import", "translate"} <= classes
+    assert any(c.startswith("read.") for c in classes)
+
+
+def test_unknown_mix_kind_rejected():
+    with pytest.raises(ValueError):
+        WorkloadConfig(mix={"frobnicate": 1.0})
+
+
+def test_zipf_skews_toward_hot_ranks():
+    z = Zipf(1000, 0.99)
+    rng = np.random.default_rng(0)
+    samples = [z.sample(rng) for _ in range(5000)]
+    counts = np.bincount(samples, minlength=1000)
+    # rank 0 is the hot key; the cold half is collectively rarer than it
+    assert counts[0] > 100
+    assert counts[0] > counts[500:].sum() / 5
+    assert max(samples) < 1000 and min(samples) >= 0
+
+
+def test_time_quantum_ops_carry_timestamps():
+    g = WorkloadGenerator(WorkloadConfig(seed=2))
+    ops = g.sequence(50, mix={"set_tq": 1.0, "range_time": 1.0})
+    for op in ops:
+        body = op.body.decode()
+        assert "2026-01-" in body
+        assert op.kind in ("set_tq", "range_time")
+
+
+def test_stage_spec_op_count_and_meta():
+    st = StageSpec("s", duration=2.0, rate=75.0, workers=4)
+    assert st.op_count == 150
+    assert StageSpec("s", 0.001, 1.0, 1).op_count == 1
+    assert st.to_dict()["rate"] == 75.0
+
+
+# -- report -------------------------------------------------------------------
+
+
+def _fake_server_slo():
+    return {
+        "classes": {
+            "write": {
+                "objective": {"availability": 0.999, "latencyP99Ms": 50.0},
+                "ok": True,
+                "alerts": {"fast": False},
+                "latencyOk": True,
+                "latency": {"p99Ms": 2.0},
+            }
+        }
+    }
+
+
+def _fake_report(records):
+    return build_report(
+        config={"seed": 1},
+        stages=[{"name": "s", "ops": len(records)}],
+        records=records,
+        client_errors=0,
+        wall_seconds=1.0,
+        sequence_fingerprint="abc",
+        server_slo=_fake_server_slo(),
+        live_slo_ok=True,
+        slo_metrics_present=True,
+    )
+
+
+def test_build_report_aggregates_and_verdicts():
+    records = [("write", 0.002, 0.001, True, 200)] * 99 + [
+        ("write", 0.050, 0.040, False, 500)
+    ]
+    r = _fake_report(records)
+    validate_report(r)
+    w = r["ops"]["write"]
+    assert w["count"] == 100 and w["errors"] == 1
+    assert w["errorRatio"] == pytest.approx(0.01)
+    assert w["p50Ms"] == pytest.approx(2.0)
+    assert w["p999Ms"] == pytest.approx(50.0)  # the straggler is the tail
+    assert r["verdicts"]["write"]["pass"] is True
+    assert r["pass"] is True
+    assert r["throughputOpsPerSec"] == pytest.approx(100.0)
+
+
+def test_validate_report_rejects_broken_schemas():
+    good = _fake_report([("write", 0.001, 0.001, True, 200)])
+    with pytest.raises(ValueError):
+        validate_report({**good, "schema": "bogus/v0"})
+    with pytest.raises(ValueError):
+        validate_report({k: v for k, v in good.items() if k != "serverSLO"})
+    with pytest.raises(ValueError):
+        validate_report({**good, "ops": {}})
+
+
+def test_next_report_path_numbering(tmp_path):
+    p1 = next_report_path(str(tmp_path))
+    assert p1.endswith("SLO_r01.json")
+    (tmp_path / "SLO_r01.json").write_text("{}")
+    (tmp_path / "SLO_r07.json").write_text("{}")
+    assert next_report_path(str(tmp_path)).endswith("SLO_r08.json")
+
+
+# -- end-to-end ---------------------------------------------------------------
+
+
+def test_short_harness_run_emits_valid_report():
+    cfg = WorkloadConfig(seed=77, n_cols=5_000)
+    report = run_harness(
+        cfg,
+        [StageSpec("burst", 1.0, 50.0, 3)],
+        nodes=1,
+        cluster_kwargs={
+            "slo_burn_rules": [
+                {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4},
+                {"name": "slow", "long": 300.0, "short": 60.0, "factor": 1.0},
+            ],
+            "slo_slot_seconds": 1.0,
+            "slo_latency_window": 60.0,
+        },
+        preload_bits=256,
+    )
+    validate_report(report)
+    assert report["clientErrors"] == 0
+    assert report["totalOps"] >= 50
+    assert report["liveSLOServedDuringRun"]
+    assert report["sloMetricsPresent"]
+    assert json.dumps(report)  # the artifact must be JSON-serializable
+    # the server saw the same classes the client drove
+    for cls in report["ops"]:
+        assert report["serverSLO"]["classes"][cls]["total"] > 0

@@ -1,0 +1,353 @@
+"""SLO plane (pilosa_tpu/obs/slo.py + HTTP wiring): query
+classification into op classes, ring-window availability accounting,
+bucketed latency quantiles, multi-window multi-burn-rate alerting, and
+the live /debug/slo + pilosa_slo_* + /debug/vars exposition — including
+the error-attribution contract (deadline 504s burn budget, 4xx client
+mistakes do not) and the translate-path telemetry riding along."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.obs import slo
+from pilosa_tpu.obs.slo import (
+    LATENCY_BOUNDS,
+    BurnRule,
+    Objective,
+    SLOTracker,
+    _bucket_of,
+    _N_BUCKETS,
+    _quantile,
+    _Ring,
+    classify_query,
+    objectives_from_dict,
+)
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+# Burn rules small enough that a test's observations all land inside
+# every window (observe() stamps wall-now; only _Ring takes a fake clock).
+FAST_RULES = (
+    BurnRule("fast", long=60.0, short=10.0, factor=14.4),
+    BurnRule("slow", long=300.0, short=60.0, factor=1.0),
+)
+
+
+def _get(uri, path):
+    return json.load(urllib.request.urlopen(uri + path, timeout=10))
+
+
+def _get_text(uri, path):
+    with urllib.request.urlopen(uri + path, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _post(uri, path, body, ctype="text/plain"):
+    req = urllib.request.Request(
+        uri + path, data=body.encode(), method="POST",
+        headers={"Content-Type": ctype},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# -- classification -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "text,want",
+    [
+        ("Count(Row(f=1))", slo.OP_READ_COUNT),
+        ("Count(Intersect(Row(f=1), Row(f=2)))", slo.OP_READ_COUNT),
+        ("TopN(f, n=5)", slo.OP_READ_TOPN),
+        ("Row(f=1)", slo.OP_READ_ROW),
+        ("GroupBy(Rows(f))", slo.OP_READ_GROUPBY),
+        ("Union(Row(f=1), Row(f=2))", slo.OP_READ_OTHER),
+        ("Set(1, f=1)", slo.OP_WRITE),
+        ("Clear(1, f=1)", slo.OP_WRITE),
+    ],
+)
+def test_classify_query(text, want):
+    assert classify_query(pql.parse(text)) == want
+
+
+def test_any_write_call_makes_the_request_a_write():
+    q = pql.parse("Row(f=1) Set(2, f=2)")
+    assert classify_query(q) == slo.OP_WRITE
+
+
+def test_note_take_class_round_trip_and_reset():
+    # drain anything a prior in-thread direct api.query call noted (the
+    # HTTP layer's finally is what consumes it in production)
+    slo.take_class()
+    assert slo.take_class() is None
+    slo.note_class(slo.OP_IMPORT)
+    assert slo.take_class() == slo.OP_IMPORT
+    # taking clears: the next request on this thread starts clean
+    assert slo.take_class() is None
+
+
+# -- buckets and quantiles ----------------------------------------------------
+
+
+def test_latency_bounds_are_strictly_increasing_and_sub_ms():
+    assert list(LATENCY_BOUNDS) == sorted(LATENCY_BOUNDS)
+    assert len(set(LATENCY_BOUNDS)) == len(LATENCY_BOUNDS)
+    # resolution below 1 ms: the 0.07-0.16 ms serving floor must not
+    # collapse into one bucket
+    assert sum(1 for b in LATENCY_BOUNDS if b < 0.001) >= 5
+
+
+def test_bucket_of_maps_bounds_and_overflow():
+    assert _bucket_of(0.0) == 0
+    assert _bucket_of(LATENCY_BOUNDS[0]) == 0
+    assert _bucket_of(LATENCY_BOUNDS[-1]) == len(LATENCY_BOUNDS) - 1
+    assert _bucket_of(LATENCY_BOUNDS[-1] + 1.0) == _N_BUCKETS - 1
+
+
+def test_quantile_empty_and_overflow_floor():
+    assert _quantile([0] * _N_BUCKETS, 0.5) is None
+    only_overflow = [0] * _N_BUCKETS
+    only_overflow[-1] = 10
+    # overflow reports the top bound (a floor, not an estimate)
+    assert _quantile(only_overflow, 0.5) == LATENCY_BOUNDS[-1]
+
+
+def test_quantile_interpolates_within_bucket():
+    counts = [0] * _N_BUCKETS
+    counts[5] = 100
+    lo, hi = LATENCY_BOUNDS[4], LATENCY_BOUNDS[5]
+    q50 = _quantile(counts, 0.5)
+    assert lo < q50 <= hi
+    assert _quantile(counts, 0.01) < q50 < _quantile(counts, 0.99)
+
+
+# -- ring windows -------------------------------------------------------------
+
+
+def test_ring_expires_observations_outside_window():
+    r = _Ring(window=60.0, slot_seconds=5.0)
+    r.observe(0.0, error=True, bucket=3)
+    assert r.sum_window(30.0, 60.0) == (1, 1)
+    # 2 minutes later the slot is outside every 60 s window
+    assert r.sum_window(120.0, 60.0) == (0, 0)
+    r.observe(118.0, error=False, bucket=3)
+    assert r.sum_window(120.0, 60.0) == (1, 0)
+    assert r.merged_buckets(120.0, 60.0)[3] == 1
+
+
+def test_ring_slot_reuse_resets_stale_counts():
+    r = _Ring(window=10.0, slot_seconds=1.0)
+    r.observe(0.5, error=True, bucket=0)
+    n = len(r.slots)
+    # land in the SAME physical slot one full ring revolution later:
+    # stale totals must not leak into the new slice
+    r.observe(0.5 + n, error=False, bucket=0)
+    total, errors = r.sum_window(0.5 + n, 1.0)
+    assert (total, errors) == (1, 0)
+
+
+# -- tracker ------------------------------------------------------------------
+
+
+def test_tracker_all_success_is_ok_and_alert_free():
+    t = SLOTracker(burn_rules=FAST_RULES, latency_window=60.0)
+    for _ in range(50):
+        t.observe(slo.OP_READ_COUNT, 0.002)
+    c = t.snapshot()["classes"][slo.OP_READ_COUNT]
+    assert c["total"] == 50 and c["errors"] == 0
+    assert c["windows"]["1m"]["availability"] == 1.0
+    assert c["windows"]["1m"]["burnRate"] == 0.0
+    assert not any(c["alerts"].values())
+    assert c["latencyOk"] is True  # 2 ms << the 50 ms objective
+    assert c["ok"] is True
+    # quantiles resolve inside the 2.5 ms bucket
+    assert 1.0 <= c["latency"]["p50Ms"] <= 2.5
+
+
+def test_tracker_sustained_errors_fire_both_burn_windows():
+    t = SLOTracker(burn_rules=FAST_RULES)
+    for i in range(100):
+        t.observe(slo.OP_WRITE, 0.001, error=(i % 2 == 0))
+    c = t.snapshot()["classes"][slo.OP_WRITE]
+    # 50% errors against a 0.1% budget: burn 500x in every window
+    assert c["alerts"]["fast"] and c["alerts"]["slow"]
+    assert c["ok"] is False
+    assert c["windows"]["10s"]["burnRate"] > 14.4
+    assert 0 < c["windows"]["10s"]["budgetConsumed"]
+
+
+def test_tracker_alert_needs_traffic_in_both_windows():
+    # a class with an objective but zero traffic must not page
+    t = SLOTracker(burn_rules=FAST_RULES)
+    c = t.snapshot()["classes"][slo.OP_READ_COUNT]
+    assert not any(c["alerts"].values())
+    assert c["total"] == 0
+
+
+def test_tracker_latency_blowout_fails_ok_without_alert():
+    t = SLOTracker(burn_rules=FAST_RULES, latency_window=60.0)
+    for _ in range(50):
+        t.observe(slo.OP_READ_COUNT, 0.4)  # way past the 50 ms p99 target
+    c = t.snapshot()["classes"][slo.OP_READ_COUNT]
+    assert not any(c["alerts"].values())  # no availability burn
+    assert c["latencyOk"] is False
+    assert c["ok"] is False
+
+
+def test_tracker_objectiveless_class_never_verdicts():
+    t = SLOTracker(burn_rules=FAST_RULES)
+    for i in range(10):
+        t.observe(slo.OP_INTERNAL, 0.001, error=(i == 0))
+    c = t.snapshot()["classes"][slo.OP_INTERNAL]
+    assert c["objective"] is None
+    assert c["ok"] is None
+    assert "burnRate" not in c["windows"]["10s"]
+    assert not any(c["alerts"].values())
+
+
+def test_tracker_prometheus_text_series():
+    t = SLOTracker(burn_rules=FAST_RULES)
+    t.observe(slo.OP_READ_COUNT, 0.003)
+    t.observe(slo.OP_READ_COUNT, 0.003, error=True)
+    text = t.prometheus_text()
+    assert 'pilosa_slo_requests_total{class="read.count"} 2' in text
+    assert 'pilosa_slo_errors_total{class="read.count"} 1' in text
+    assert 'pilosa_slo_availability{class="read.count",window="1m"}' in text
+    assert 'pilosa_slo_burn_rate{class="read.count",window="10s"}' in text
+    assert 'pilosa_slo_latency_seconds{class="read.count",quantile="0.99"}' in text
+    assert 'pilosa_slo_alert{class="read.count",rule="fast"}' in text
+    assert "# TYPE pilosa_slo_requests_total counter" in text
+
+
+def test_summary_is_compact_verdict_view():
+    t = SLOTracker(burn_rules=FAST_RULES)
+    t.observe(slo.OP_WRITE, 0.001)
+    s = t.summary()
+    assert s["classes"][slo.OP_WRITE]["total"] == 1
+    assert "windows" not in s["classes"][slo.OP_WRITE]
+
+
+def test_objectives_from_dict_overrides_and_drops():
+    objs = objectives_from_dict(
+        {
+            "write": {"availability": 0.95, "latencyP99Ms": 500},
+            "import": None,
+        }
+    )
+    assert objs["write"].availability == 0.95
+    assert objs["write"].latency_p99 == 0.5
+    assert "import" not in objs
+    # untouched defaults survive
+    assert objs["read.count"].availability == 0.999
+
+
+def test_objective_rejects_degenerate_targets():
+    with pytest.raises(ValueError):
+        Objective(1.0)
+    with pytest.raises(ValueError):
+        Objective(0.0)
+
+
+# -- HTTP integration ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with InProcessCluster(
+        1,
+        with_disk=True,  # a real translate log, so logAppends moves
+        slo_burn_rules=[
+            {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4},
+            {"name": "slow", "long": 300.0, "short": 60.0, "factor": 1.0},
+        ],
+        slo_slot_seconds=1.0,
+        slo_latency_window=60.0,
+    ) as c:
+        c.create_index("slotest")
+        c.create_field("slotest", "f")
+        c.create_index("slokeys", {"keys": True})
+        c.create_field("slokeys", "tag", {"keys": True})
+        yield c
+
+
+def test_http_requests_classified_into_op_classes(cluster):
+    uri = cluster.nodes[0].uri
+    _post(uri, "/index/slotest/query", "Set(1, f=1)")
+    _post(uri, "/index/slotest/query", "Count(Row(f=1))")
+    _post(uri, "/index/slotest/query", "TopN(f, n=2)")
+    snap = _get(uri, "/debug/slo")
+    classes = snap["classes"]
+    assert classes["write"]["total"] >= 1
+    assert classes["read.count"]["total"] >= 1
+    assert classes["read.topn"]["total"] >= 1
+    assert classes["read.count"]["latency"]["p50Ms"] is not None
+    # snapshot shape: burn rules + windows named from the short config
+    assert {r["name"] for r in snap["burnRules"]} == {"fast", "slow"}
+    assert "1m" in classes["read.count"]["windows"]
+
+
+def test_client_errors_do_not_burn_budget(cluster):
+    uri = cluster.nodes[0].uri
+    before = _get(uri, "/debug/slo")["classes"]["read.other"]["errors"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(uri, "/index/slotest/query", "Nonsense(((")
+    assert ei.value.code == 400
+    after = _get(uri, "/debug/slo")["classes"]["read.other"]["errors"]
+    assert after == before  # a parse error is the client's problem
+
+
+def test_deadline_504_burns_error_budget(cluster):
+    uri = cluster.nodes[0].uri
+
+    def total_errors():
+        return sum(
+            c["errors"] for c in _get(uri, "/debug/slo")["classes"].values()
+        )
+
+    before = total_errors()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(
+            uri,
+            "/index/slotest/query?timeout=0.000000001",
+            "Count(Row(f=1))",
+        )
+    assert ei.value.code == 504
+    # the budget can expire before the API layer classifies the query,
+    # in which case the 504 lands on the route's fallback class — either
+    # way it burns exactly one request of budget
+    assert total_errors() == before + 1
+
+
+def test_metrics_carry_slo_and_translate_series(cluster):
+    uri = cluster.nodes[0].uri
+    # put translation on the hot path (keyed row + column)
+    _post(uri, "/index/slokeys/query", 'Set("u1", tag="hot")')
+    _post(uri, "/index/slokeys/query", 'Count(Row(tag="hot"))')
+    _post(
+        uri,
+        "/internal/translate/keys",
+        json.dumps({"index": "slokeys", "field": "", "keys": ["u1", "u2"]}),
+        ctype="application/json",
+    )
+    text = _get_text(uri, "/metrics")
+    assert "pilosa_slo_requests_total" in text
+    assert "pilosa_slo_availability" in text
+    assert "pilosa_translate_keys_created" in text
+    assert "pilosa_translate_keys_found" in text
+    assert "pilosa_translate_lookup_seconds_bucket" in text
+    snap = _get(uri, "/debug/slo")
+    assert snap["classes"]["translate"]["total"] >= 1
+
+
+def test_debug_vars_carry_slo_and_translate_blocks(cluster):
+    uri = cluster.nodes[0].uri
+    _post(uri, "/index/slotest/query", "Count(Row(f=1))")
+    v = _get(uri, "/debug/vars")
+    assert v["slo"]["classes"]["read.count"]["total"] >= 1
+    assert "burnRules" in v["slo"]
+    t = v["translate"]
+    assert t["keysCreated"] >= 1
+    assert t["logAppends"] >= 1

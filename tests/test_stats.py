@@ -175,3 +175,35 @@ def test_parse_statsd_host_forms():
     assert _parse_statsd_host("[2001:db8::2]") == ("2001:db8::2", 8125)
     assert _parse_statsd_host("") == ("127.0.0.1", 8125)
     assert _parse_statsd_host("host:notaport") == ("host", 8125)
+
+
+def test_histogram_snapshot_carries_inf_overflow_bucket():
+    from pilosa_tpu.obs.stats import HISTOGRAM_BUCKETS
+
+    s = MemStatsClient()
+    s.timing("op", 0.002)
+    s.timing("op", 9999.0)  # past the largest bound: overflow only
+    h = s.snapshot()["histograms"]["op_seconds"]
+    buckets = h["buckets"]
+    assert buckets["+Inf"] == 2  # cumulative: every observation lands here
+    assert buckets[str(HISTOGRAM_BUCKETS[-1])] == 1  # overflow excluded
+    # the overflow observation is recoverable: +Inf minus the top bound
+    assert buckets["+Inf"] - buckets[str(HISTOGRAM_BUCKETS[-1])] == 1
+
+
+def test_histogram_buckets_resolve_sub_millisecond():
+    from pilosa_tpu.obs.stats import HISTOGRAM_BUCKETS
+
+    # the serving floor is 0.07-0.16 ms/op (BENCH_r05); bucket edges
+    # below 1 ms keep those observations distinguishable
+    sub_ms = [b for b in HISTOGRAM_BUCKETS if b < 0.001]
+    assert len(sub_ms) >= 4
+    assert min(HISTOGRAM_BUCKETS) <= 0.00005
+    s = MemStatsClient()
+    s.timing("fast", 0.00007)
+    s.timing("fast", 0.00090)
+    buckets = s.snapshot()["histograms"]["fast_seconds"]["buckets"]
+    # cumulative counts: the 0.07 ms observation is visible below the
+    # 0.25 ms edge, separated from the 0.9 ms one
+    assert buckets["0.0001"] == 1
+    assert buckets["0.001"] == 2

@@ -1,0 +1,147 @@
+"""Time-quantum serving under concurrent ingest: streaming timestamped
+``Set`` calls landing in time views while ``Range`` queries execute
+against the same field over the same HTTP path (the load harness's
+``timequantum`` stage in tools/loadharness.py runs this shape at rate;
+this test pins the correctness contract it relies on).
+
+Contract: mid-ingest reads never fail and never see MORE than what has
+been written; once the writers join, every time window reads back
+exactly the deterministic write plan."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.testing.cluster import InProcessCluster
+
+N_WRITERS = 3
+WRITES_PER_WRITER = 60
+N_ROWS = 4
+N_DAYS = 6
+
+
+def _post(uri, index, pql):
+    req = urllib.request.Request(
+        f"{uri}/index/{index}/query", data=pql.encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _ts(day, hour=0):
+    return f"2026-01-{day + 1:02d}T{hour:02d}:00"
+
+
+def _write_plan(seed):
+    """Deterministic (writer, row, col, day, hour) plan: columns unique
+    across the whole plan so expected counts are exact set sizes."""
+    rng = np.random.default_rng(seed)
+    plan = []
+    col = 0
+    for w in range(N_WRITERS):
+        for _ in range(WRITES_PER_WRITER):
+            plan.append(
+                (
+                    w,
+                    int(rng.integers(0, N_ROWS)),
+                    col,
+                    int(rng.integers(0, N_DAYS)),
+                    int(rng.integers(0, 24)),
+                )
+            )
+            col += 1
+    return plan
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with InProcessCluster(1) as c:
+        c.create_index("tq")
+        c.create_field("tq", "ev", {"type": "time", "timeQuantum": "YMDH"})
+        yield c
+
+
+def test_range_reads_stay_consistent_under_concurrent_ingest(cluster):
+    uri = cluster.nodes[0].uri
+    plan = _write_plan(seed=11)
+    full_span = f"Count(Range(ev=0, {_ts(0)}, {_ts(N_DAYS)}))"
+    final_row0 = sum(1 for _, r, _c, _d, _h in plan if r == 0)
+
+    errors: list[str] = []
+    observed: list[int] = []
+    writers_done = threading.Event()
+
+    def writer(wid):
+        try:
+            for w, r, c, d, h in plan:
+                if w != wid:
+                    continue
+                _post(uri, "tq", f"Set({c}, ev={r}, {_ts(d, h)})")
+        except Exception as e:  # noqa: BLE001 - surfaced via errors list
+            errors.append(f"writer {wid}: {e!r}")
+
+    def reader():
+        try:
+            while not writers_done.is_set():
+                n = _post(uri, "tq", full_span)["results"][0]
+                observed.append(n)
+        except Exception as e:  # noqa: BLE001 - surfaced via errors list
+            errors.append(f"reader: {e!r}")
+
+    wthreads = [
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(N_WRITERS)
+    ]
+    rthreads = [threading.Thread(target=reader, daemon=True) for _ in range(2)]
+    for t in rthreads + wthreads:
+        t.start()
+    for t in wthreads:
+        t.join(timeout=60)
+    writers_done.set()
+    for t in rthreads:
+        t.join(timeout=60)
+
+    assert not errors, errors
+    assert observed, "readers never completed a query during ingest"
+    # mid-ingest reads never exceed the final state and never go backward
+    # relative to what the write order allows
+    assert max(observed) <= final_row0
+    # convergence: the full span reads back the exact plan
+    assert _post(uri, "tq", full_span)["results"][0] == final_row0
+
+
+def test_per_window_counts_match_plan_after_ingest(cluster):
+    # runs after the concurrent test on the same cluster state: every
+    # (row, day) window must read back exactly the plan's bit set
+    plan = _write_plan(seed=11)
+    uri = cluster.nodes[0].uri
+    for row in range(N_ROWS):
+        for day in range(N_DAYS):
+            want = sum(
+                1 for _, r, _c, d, _h in plan if r == row and d == day
+            )
+            got = _post(
+                uri, "tq",
+                f"Count(Range(ev={row}, {_ts(day)}, {_ts(day + 1)}))",
+            )["results"][0]
+            assert got == want, (row, day, got, want)
+
+
+def test_hour_subwindow_is_finer_than_day(cluster):
+    plan = _write_plan(seed=11)
+    uri = cluster.nodes[0].uri
+    row, day = plan[0][1], plan[0][3]
+    day_n = _post(
+        uri, "tq", f"Count(Range(ev={row}, {_ts(day)}, {_ts(day + 1)}))"
+    )["results"][0]
+    # sum of the day's hour windows equals the day window (YMDH views)
+    hour_sum = 0
+    for h in range(24):
+        t1, t2 = _ts(day, h), (_ts(day, h + 1) if h < 23 else _ts(day + 1))
+        hour_sum += _post(
+            uri, "tq", f"Count(Range(ev={row}, {t1}, {t2}))"
+        )["results"][0]
+    assert hour_sum == day_n

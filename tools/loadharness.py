@@ -1,0 +1,160 @@
+"""SLO load harness CLI: drive a seeded, deterministic mixed workload
+(zipfian key popularity, time-quantum ingest + concurrent time-range
+reads, string-key translation, bulk imports) through the real HTTP path
+of an in-process cluster and emit a machine-readable ``SLO_rNN.json``
+report next to the ``BENCH_*.json`` artifacts.
+
+Default stage plan (scaled by --duration/--rate/--workers):
+
+    warm        read-heavy mix at half rate/concurrency
+    timequantum streaming timestamped SetBit + concurrent Range reads
+    ramp        full mix at full rate and concurrency
+
+Examples::
+
+    python -m tools.loadharness --seed 7 --duration 9 --rate 150
+    python -m tools.loadharness --nodes 2 --fault slow,node=1,delay=0.05
+    python -m tools.loadharness --print-sequence | head
+
+Two runs with the same seed generate identical request sequences; the
+report's ``sequenceFingerprint`` is the proof (and the regression
+anchor).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pilosa_tpu.loadgen import (
+    StageSpec,
+    WorkloadConfig,
+    WorkloadGenerator,
+    next_report_path,
+    run_harness,
+    validate_report,
+)
+
+# Burn windows shrunk to the harness's time scale: a seconds-long run
+# must land inside the fast windows or the error budget reads as empty.
+SHORT_BURN_RULES = [
+    {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4},
+    {"name": "slow", "long": 300.0, "short": 60.0, "factor": 1.0},
+]
+
+READ_HEAVY_MIX = {
+    "count": 34.0, "row": 14.0, "topn": 10.0, "range_time": 8.0,
+    "groupby": 6.0, "set": 10.0, "key_count": 10.0, "translate": 8.0,
+}
+TIMEQUANTUM_MIX = {
+    "set_tq": 45.0, "range_time": 30.0, "count": 10.0, "set": 5.0,
+    "key_set": 5.0, "translate": 5.0,
+}
+
+
+def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
+    third = max(1.0, duration / 3.0)
+    return [
+        StageSpec("warm", third, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", third, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("ramp", third, rate * 1.5, workers, None),
+    ]
+
+
+def parse_fault(spec: str) -> dict:
+    """``kind[,k=v...]`` -> inject_fault kwargs, e.g.
+    ``slow,node=1,delay=0.05,p=0.5``."""
+    parts = spec.split(",")
+    out: dict = {"kind": parts[0]}
+    for p in parts[1:]:
+        k, _, v = p.partition("=")
+        if k in ("node", "times", "code"):
+            out[k] = int(v)
+        elif k in ("delay", "p"):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--nodes", type=int, default=1)
+    ap.add_argument("--duration", type=float, default=9.0,
+                    help="total seconds across the three stages")
+    ap.add_argument("--rate", type=float, default=150.0,
+                    help="open-loop arrival rate (ops/s) of the full-load stages")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--preload-bits", type=int, default=4096)
+    ap.add_argument("--report", default=None,
+                    help="report path (default: next free SLO_rNN.json)")
+    ap.add_argument("--report-dir", default=".",
+                    help="directory for auto-numbered reports")
+    ap.add_argument("--fault", action="append", default=[],
+                    metavar="KIND[,k=v...]",
+                    help="inject a fault rule, e.g. slow,node=1,delay=0.05")
+    ap.add_argument("--default-deadline", type=float, default=0.0,
+                    help="server-side default request deadline (seconds)")
+    ap.add_argument("--print-sequence", action="store_true",
+                    help="print the deterministic op sequence as JSON lines"
+                         " and exit (no cluster, no load)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any SLO verdict fails (default: the"
+                         " verdict lives in the report; short cold-start runs"
+                         " legitimately blow latency objectives)")
+    args = ap.parse_args(argv)
+
+    config = WorkloadConfig(seed=args.seed)
+    stages = default_stages(args.duration, args.rate, args.workers)
+
+    if args.print_sequence:
+        gen = WorkloadGenerator(config)
+        for st in stages:
+            for op in gen.sequence(st.op_count, st.mix):
+                print(json.dumps({"stage": st.name, **op.to_wire()}))
+        return 0
+
+    report = run_harness(
+        config,
+        stages,
+        nodes=args.nodes,
+        cluster_kwargs={
+            "slo_burn_rules": SHORT_BURN_RULES,
+            "slo_slot_seconds": 1.0,
+            "slo_latency_window": 60.0,
+            "default_deadline": args.default_deadline,
+        },
+        faults=[parse_fault(f) for f in args.fault],
+        preload_bits=args.preload_bits,
+    )
+    validate_report(report)
+    path = args.report or next_report_path(args.report_dir)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    print(f"wrote {path}")
+    print(
+        f"ops={report['totalOps']} wall={report['wallSeconds']:.1f}s "
+        f"throughput={report['throughputOpsPerSec']:.0f} ops/s "
+        f"clientErrors={report['clientErrors']}"
+    )
+    for name, c in report["ops"].items():
+        print(
+            f"  {name:<14} n={c['count']:<6} err={c['errors']:<4} "
+            f"p50={c['p50Ms']:.2f}ms p99={c['p99Ms']:.2f}ms "
+            f"p999={c['p999Ms']:.2f}ms"
+        )
+    for name, v in report["verdicts"].items():
+        print(f"  verdict {name:<14} {'PASS' if v['pass'] else 'FAIL'}")
+    if report["pass"] is False:
+        print("SLO verdict: FAIL")
+        return 1 if args.strict else 0
+    print("SLO verdict: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""SLO-plane smoke: boot a real cluster, drive a short seeded harness
+burst over HTTP, and assert the SLO plane's end-to-end contract.
+
+Asserts:
+  * the workload generator is deterministic (same seed -> identical
+    sequence fingerprint; different seed -> different)
+  * the harness completes a mixed read/write/translate/import burst
+    with zero client-level errors
+  * /debug/slo served well-formed JSON live DURING the load stage
+  * /metrics carried the pilosa_slo_* family during the run
+  * the emitted report validates against pilosa-slo-report/v1 and has
+    latency percentiles + server budget windows for the core classes
+  * a request that blows its deadline (504) burns error budget
+
+Run: python -m tools.smoke_slo        (CI: slo smoke step)
+"""
+
+from __future__ import annotations
+
+import sys
+
+from pilosa_tpu.loadgen import (
+    StageSpec,
+    WorkloadConfig,
+    WorkloadGenerator,
+    fingerprint,
+    run_harness,
+    validate_report,
+)
+
+BURN_RULES = [
+    {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4},
+    {"name": "slow", "long": 300.0, "short": 60.0, "factor": 1.0},
+]
+
+
+def main() -> int:
+    config = WorkloadConfig(seed=1234, n_cols=10_000)
+
+    # determinism: the whole point of a seeded harness
+    fp1 = fingerprint(WorkloadGenerator(config).sequence(200))
+    fp2 = fingerprint(WorkloadGenerator(config).sequence(200))
+    fp3 = fingerprint(
+        WorkloadGenerator(WorkloadConfig(seed=4321, n_cols=10_000)).sequence(200)
+    )
+    assert fp1 == fp2, "same seed must replay the same sequence"
+    assert fp1 != fp3, "different seeds must diverge"
+
+    stages = [
+        StageSpec("warm", 1.0, 40.0, 2),
+        StageSpec("mix", 1.5, 80.0, 4),
+    ]
+    report = run_harness(
+        config,
+        stages,
+        nodes=1,
+        cluster_kwargs={
+            "slo_burn_rules": BURN_RULES,
+            "slo_slot_seconds": 1.0,
+            "slo_latency_window": 60.0,
+        },
+        preload_bits=512,
+    )
+    validate_report(report)
+    assert report["clientErrors"] == 0, report["clientErrors"]
+    assert report["liveSLOServedDuringRun"], "/debug/slo down during load"
+    assert report["sloMetricsPresent"], "pilosa_slo_* missing from /metrics"
+    assert report["sequenceFingerprint"], "report must carry the seq hash"
+
+    ops = report["ops"]
+    for cls in ("read.count", "write"):
+        assert cls in ops, f"mixed burst never exercised {cls}"
+        assert ops[cls]["p50Ms"] is not None
+        assert ops[cls]["p999Ms"] is not None
+
+    classes = report["serverSLO"]["classes"]
+    wcls = classes["write"]
+    assert wcls["total"] > 0
+    # window names derive from the configured burn rules (60s/10s fast,
+    # 300s/60s slow -> "1m"/"10s"/"5m")
+    assert "1m" in wcls["windows"] and "10s" in wcls["windows"]
+    assert wcls["latency"]["p99Ms"] is not None
+    assert "fast" in wcls["alerts"] and "slow" in wcls["alerts"]
+
+    # deadline blowout burns budget: re-run a burst with an absurdly
+    # tight server-side deadline and expect 504s in the error windows
+    tight = run_harness(
+        config,
+        [StageSpec("tight", 1.0, 40.0, 2)],
+        nodes=1,
+        cluster_kwargs={
+            "slo_burn_rules": BURN_RULES,
+            "slo_slot_seconds": 1.0,
+            "slo_latency_window": 60.0,
+            "default_deadline": 1e-6,
+        },
+        preload_bits=0,
+    )
+    validate_report(tight)
+    burned = sum(
+        c["errors"] for c in tight["serverSLO"]["classes"].values()
+    )
+    assert burned > 0, "deadline 504s must burn error budget"
+
+    print("slo smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
