@@ -41,7 +41,7 @@ from jax import lax
 from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.core.view import VIEW_STANDARD
-from pilosa_tpu.pql.ast import Call
+from pilosa_tpu.pql.ast import Call, Condition
 
 # Device launches issued by compiled programs (tests assert O(1) per
 # batch; one count-group launch answers every same-shape Count).
@@ -350,3 +350,77 @@ def run_bitmap(sig, stacks: tuple, slots_np: np.ndarray):
     assert slots_np.shape[0] == n_leaves
     launches += 1
     return fn(stacks, jnp.asarray(slots_np))
+
+
+# ------------------------------------------------------------- BSI signing
+#
+# BSI op classes the executor's cross-request batch lane understands
+# (executor._batch_bsi).  A signed call joins a (field, depth, op-class)
+# flight group and is answered by ONE shared slice-plane launch per group
+# (ops/bsi.py batched kernels).  The dispatch-parity graftlint pass
+# (part C) checks this class list against the executor's handlers, so a
+# class signed here but never grouped there is a CI failure.
+
+BSI_RANGE = "bsi.range"
+BSI_RANGE_COUNT = "bsi.range_count"
+BSI_SUM = "bsi.sum"
+BSI_MIN = "bsi.min"
+BSI_MAX = "bsi.max"
+BSI_GROUPBY = "bsi.groupby"
+
+BSI_OP_CLASSES = (
+    BSI_RANGE, BSI_RANGE_COUNT, BSI_SUM, BSI_MIN, BSI_MAX, BSI_GROUPBY,
+)
+
+
+def _bsi_condition(idx, call: Call):
+    """(field, Condition) when ``call`` is a pure BSI range predicate —
+    ``Row(v < 3)`` / ``Range(v < 3)`` over an int field; None otherwise.
+    ``== null`` is left unsigned so the per-call path raises it inside
+    the owning query's demux scope."""
+    if call.name not in ("Row", "Range") or call.children:
+        return None
+    fname = call.field_arg()
+    if fname is None or set(call.args) != {fname}:
+        return None
+    field = idx.field(fname)
+    if field is None or not field.is_bsi():
+        return None
+    cond = call.args.get(fname)
+    if not isinstance(cond, Condition):
+        return None
+    if cond.op == "==" and cond.value is None:
+        return None
+    return field, cond
+
+
+def match_bsi(idx, call: Call):
+    """Sign one call as BSI-batchable: ``(op_class, field, condition)``
+    (condition None for the aggregate classes, which carry their filter
+    as a child/arg instead) or None.  Conservative by construction —
+    anything unsigned keeps the exact per-call semantics."""
+    name = call.name
+    m = _bsi_condition(idx, call)
+    if m is not None:
+        return BSI_RANGE, m[0], m[1]
+    if name == "Count" and len(call.children) == 1 and not call.args:
+        m = _bsi_condition(idx, call.children[0])
+        if m is not None:
+            return BSI_RANGE_COUNT, m[0], m[1]
+        return None
+    if name in ("Sum", "Min", "Max"):
+        fname, ok = call.string_arg("field")
+        if not ok:
+            fname = call.args.get("_field")
+        field = idx.field(fname) if fname else None
+        if field is None or not field.is_bsi():
+            return None
+        cls = {"Sum": BSI_SUM, "Min": BSI_MIN, "Max": BSI_MAX}[name]
+        return cls, field, None
+    if name == "GroupBy":
+        filt, has = call.call_arg("filter")
+        if has and filt is not None:
+            m = _bsi_condition(idx, filt)
+            if m is not None:
+                return BSI_GROUPBY, m[0], m[1]
+    return None

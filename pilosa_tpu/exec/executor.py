@@ -143,6 +143,11 @@ class Executor:
         self.crossgram_cache_hits = 0
         # unfiltered BSI Sum/Min/Max scalars served per snapshot
         self.bsi_agg_cache_hits = 0
+        # flight items the batch lane handed back to the per-call path
+        # (malformed predicate, per-item compute trouble): the slot is
+        # re-executed — and its error re-raised — in the owning query's
+        # demux scope, so this counts fallbacks, not lost queries
+        self.bsi_batch_item_errors = 0
 
     # ------------------------------------------------------------------ API
 
@@ -181,6 +186,7 @@ class Executor:
             )
             self._batch_pair_counts(idx, calls[:first_write], shards, results)
             self._batch_general(idx, calls[:first_write], shards, results)
+            self._batch_bsi(idx, calls[:first_write], shards, results)
             for i, call in enumerate(calls):
                 if results[i] is _UNSET:
                     with tracing.start_span(f"executor.execute{call.name}"):
@@ -243,6 +249,7 @@ class Executor:
                 flat_results: list[Any] = [_UNSET] * len(flat_calls)
                 self._batch_pair_counts(idx, flat_calls, shards, flat_results)
                 self._batch_general(idx, flat_calls, shards, flat_results)
+                self._batch_bsi(idx, flat_calls, shards, flat_results)
                 pos = 0
                 for qi in qis:
                     calls = cloned[qi]
@@ -1292,6 +1299,284 @@ class Executor:
                 if field is not None and isinstance(v, int) and not isinstance(v, bool):
                     row.attrs = field.row_attrs.attrs(v)
         return row
+
+    # ------------------------------------------------ batched BSI fast path
+
+    # filter-tensor ceiling for the fused batched Sum ([S, Q, W] uint32
+    # per launch; past this the per-query host lane answers instead)
+    _BSI_SUM_FILTER_BUDGET_BYTES = 256 << 20
+
+    @staticmethod
+    def _bsi_stored_bounds(field: Field, cond: Condition):
+        """A condition's bounds in stored space (value - base), encoded
+        for the batched kernels (ops/bsi.py condition_bounds)."""
+        op = cond.op
+        if op == "!=" and cond.value is None:
+            return bsi.condition_bounds(op, None)
+        if op == "><" or "x" in op:
+            lo, hi = cond.int_pair()
+            return bsi.condition_bounds(
+                op, (lo - field.base, hi - field.base)
+            )
+        return bsi.condition_bounds(op, int(cond.value) - field.base)
+
+    @staticmethod
+    def _sum_valcount(field: Field, tc) -> ValCount:
+        total, count = tc
+        if count == 0:
+            return ValCount()
+        return ValCount(value=total + count * field.base, count=count)
+
+    def _batch_bsi(
+        self, idx: Index, calls: list[Call], shards: list[int] | None,
+        results: list[Any],
+    ) -> None:
+        """Answer every BSI call astbatch signs as batchable with shared
+        slice-plane launches: flight-mates group by (field, depth,
+        op-class), so Q concurrent range predicates cost ONE
+        range_batch/range_count_batch dispatch and Q filtered Sums ONE
+        fused popcount matmul (ops/bsi.py batched kernels).  Per-item
+        trouble leaves the slot _UNSET for the per-call path, which
+        re-raises inside the owning query's demux scope — one bad query
+        never fails its flight-mates.
+
+        A field engages when >= 2 of its calls batch or its BSI stack is
+        already live (the pair-count warm-up economics); a lone cold
+        predicate keeps the per-call host latency tier."""
+        from pilosa_tpu.exec import astbatch
+
+        by_field: dict[str, list[tuple[int, str, Any]]] = {}
+        fields: dict[str, Field] = {}
+        for i, call in enumerate(calls):
+            if results[i] is not _UNSET:
+                continue
+            m = astbatch.match_bsi(idx, call)
+            if m is None:
+                continue
+            op_class, field, cond = m
+            by_field.setdefault(field.name, []).append((i, op_class, cond))
+            fields[field.name] = field
+        if not by_field:
+            return
+
+        shard_list: list[int] | None = None
+        for fname, items in by_field.items():
+            field = fields[fname]
+            if shard_list is None:
+                shard_list = self._shards_for(idx, shards)
+            if len(items) < 2 and not self._bsi_stack_live(
+                field, shard_list
+            ):
+                continue
+            bits = self._bsi_stack(field, shard_list)
+            if bits is None:
+                continue  # over budget: per-fragment path answers
+            groups: dict[str, list[tuple[int, Any]]] = {}
+            for i, op_class, cond in items:
+                groups.setdefault(op_class, []).append((i, cond))
+            with tracing.start_span("executor.batchBSI").set_tag(
+                "field", fname
+            ).set_tag("n", len(items)):
+                self._batch_bsi_field(
+                    idx, field, bits, groups, shard_list, calls, results
+                )
+
+    def _batch_bsi_field(
+        self, idx: Index, field: Field, bits, groups, shard_list,
+        calls: list[Call], results: list[Any],
+    ) -> None:
+        """One field's grouped BSI launches against its live stack."""
+        from pilosa_tpu.exec import astbatch
+        from pilosa_tpu.ops import kernels
+
+        if kernels.stack_spans_processes(bits):
+            # per-shard result words/partials are not host-addressable
+            # across processes; the per-call paths keep their own story
+            return
+        depth = field.bit_depth
+        split: list = []
+
+        def tensors():
+            if not split:
+                split.append(self._bsi_split(bits))
+            return split[0]
+
+        # -- range masks: Range/Row trees and GroupBy filters share ONE
+        # [Q, S, W] mask launch
+        mask_items = groups.get(astbatch.BSI_RANGE, []) + groups.get(
+            astbatch.BSI_GROUPBY, []
+        )
+        if mask_items:
+            try:
+                queries = [
+                    self._bsi_stored_bounds(field, cond)
+                    for _, cond in mask_items
+                ]
+            except (ValueError, TypeError):
+                queries = None
+            if queries is not None:
+                exists, sign, planes = tensors()
+                self.bsi_stack_launches += 1
+                with tracing.start_span("executor.bsiRangeBatch").set_tag(
+                    "n", len(mask_items)
+                ):
+                    masks = bsi.range_batch(
+                        planes, exists, sign, queries, depth=depth
+                    )
+                if getattr(masks, "sharding", None) is not None and len(
+                    getattr(masks.sharding, "device_set", ())
+                ) > 1:
+                    masks = np.asarray(masks)  # one pull for the flight
+                for qi, (i, _) in enumerate(mask_items):
+                    row = Row(n_words=self.holder.n_words)
+                    m = masks[qi]
+                    for si, s in enumerate(shard_list):
+                        row.segments[s] = m[si]
+                    if calls[i].name == "GroupBy":
+                        try:
+                            results[i] = self._execute_groupby(
+                                idx, calls[i], shard_list, filt_row=row
+                            )
+                        except Exception:
+                            # per-call path re-raises per query
+                            self.bsi_batch_item_errors += 1
+                    else:
+                        results[i] = row
+
+        # -- range counts: agg-cache hits first, the rest share one
+        # count launch (no [Q, S, W] materialization)
+        count_items = groups.get(astbatch.BSI_RANGE_COUNT, [])
+        if count_items:
+            pending: list[tuple[int, Any]] = []
+            puts: list = []
+            for i, cond in count_items:
+                keyed = self._range_count_key(idx, calls[i].children[0])
+                cached, put = (
+                    self._bsi_agg_cache(field, bits, keyed[1])
+                    if keyed is not None
+                    else (None, lambda v: None)
+                )
+                if cached is not None:
+                    results[i] = cached
+                    self._count_stat(idx)
+                else:
+                    pending.append((i, cond))
+                    puts.append(put)
+            if pending:
+                try:
+                    queries = [
+                        self._bsi_stored_bounds(field, cond)
+                        for _, cond in pending
+                    ]
+                except (ValueError, TypeError):
+                    queries = None
+                if queries is not None:
+                    exists, sign, planes = tensors()
+                    self.bsi_stack_launches += 1
+                    with tracing.start_span(
+                        "executor.bsiRangeCountBatch"
+                    ).set_tag("n", len(pending)):
+                        counts = bsi.range_count_batch(
+                            planes, exists, sign, queries, depth=depth
+                        )
+                    for (i, _), put, n in zip(pending, puts, counts):
+                        put(n)
+                        results[i] = n
+                        self._count_stat(idx)
+
+        # -- Sum: unfiltered repeats collapse onto the cached stacked
+        # aggregate; filtered Sums share one fused popcount matmul when
+        # the int32 accumulator and the filter tensor stay in budget
+        sum_items = groups.get(astbatch.BSI_SUM, [])
+        if sum_items:
+            self._batch_bsi_sums(
+                idx, field, bits, sum_items, shard_list, calls, results
+            )
+
+        # -- Min/Max: one cached scalar per (field, kind); grouped here
+        # so the flight amortizes the stack build and each item fails
+        # alone (cache-served repeats are host dictionary hits)
+        for op_class, maximal in (
+            (astbatch.BSI_MIN, False), (astbatch.BSI_MAX, True),
+        ):
+            for i, _ in groups.get(op_class, []):
+                try:
+                    results[i] = self._execute_min_max(
+                        idx, calls[i], shard_list, maximal
+                    )
+                except Exception:
+                    # per-call path re-raises per query
+                    self.bsi_batch_item_errors += 1
+
+    def _batch_bsi_sums(
+        self, idx: Index, field: Field, bits, sum_items, shard_list,
+        calls: list[Call], results: list[Any],
+    ) -> None:
+        from pilosa_tpu.ops import kernels
+
+        depth = field.bit_depth
+        S_stack, W = int(bits.shape[0]), field.n_words
+        unfiltered: list[int] = []
+        filtered: list[tuple[int, Row]] = []
+        for i, _ in sum_items:
+            try:
+                filt = self._sum_filter(idx, calls[i], shard_list)
+            except Exception:
+                # malformed: per-call path raises per query
+                self.bsi_batch_item_errors += 1
+                continue
+            if filt is None:
+                unfiltered.append(i)
+            else:
+                filtered.append((i, filt))
+        if unfiltered:
+            # every unfiltered Sum in the flight is the SAME scalar:
+            # one cached stacked compute answers them all
+            try:
+                tc = self._bsi_agg_serve(
+                    field, (bits, None, shard_list), "sum",
+                    lambda p, e, s, fw: bsi.sum_host(
+                        p, e, s, fw, depth=depth
+                    ),
+                )
+                for i in unfiltered:
+                    results[i] = self._sum_valcount(field, tc)
+            except Exception:
+                # per-call path re-raises per query
+                self.bsi_batch_item_errors += 1
+        if not filtered:
+            return
+        Q = len(filtered)
+        P = _pow2(Q)
+        if (
+            Q < 2
+            or not bsi.sum_batch_supported(S_stack, W)
+            or S_stack * P * W * 4 > self._BSI_SUM_FILTER_BUDGET_BYTES
+        ):
+            return  # per-query host lane (existing sum path) answers
+        sh = getattr(bits, "sharding", None)
+        if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+            # the [S, Q, W] filter tensor has no mesh layout matching the
+            # stack's; keep the fused path single-device for now
+            return
+        fw = np.zeros((S_stack, P, W), np.uint32)
+        for qi, (_, filt) in enumerate(filtered):
+            fw[:, qi, :] = self._row_to_shard_matrix(
+                filt, shard_list, S_stack, W
+            )
+        if P > Q:
+            kernels.note_pad(
+                "bsi_sum_batch", S_stack * P * W * 4, S_stack * Q * W * 4
+            )
+        exists, sign, planes = self._bsi_split(bits)
+        filters = jnp.asarray(fw)
+        self.bsi_stack_launches += 1
+        with tracing.start_span("executor.bsiSumBatch").set_tag("n", Q):
+            pairs = bsi.sum_batch_host(
+                planes, exists, sign, filters, depth=depth
+            )
+        for (i, _), tc in zip(filtered, pairs):
+            results[i] = self._sum_valcount(field, tc)
 
     def _bitmap_call(self, idx: Index, call: Call, shards: list[int]) -> Row:
         name = call.name
@@ -2416,9 +2701,15 @@ class Executor:
 
     # --------------------------------------------------------------- GroupBy
 
-    def _execute_groupby(self, idx: Index, call: Call, shards: list[int] | None) -> list[GroupCount]:
+    def _execute_groupby(
+        self, idx: Index, call: Call, shards: list[int] | None,
+        filt_row=_UNSET,
+    ) -> list[GroupCount]:
         """reference executor.go:1071-1275: nested cross-product of Rows()
-        children, each level intersected with the previous."""
+        children, each level intersected with the previous.  ``filt_row``
+        lets the batched BSI lane hand in a precomputed filter row (its
+        Range filter rode a shared range_batch launch); the _UNSET
+        default computes it from the call as before."""
         shards = self._shards_for(idx, shards)
         if not call.children:
             raise ExecuteError("GroupBy requires at least one Rows() child")
@@ -2433,9 +2724,10 @@ class Executor:
                 "'previous' argument must have a value for each GroupBy field"
             )
 
-        filt_row = (
-            self._bitmap_call(idx, filt_call, shards) if has_filt else None
-        )
+        if filt_row is _UNSET:
+            filt_row = (
+                self._bitmap_call(idx, filt_call, shards) if has_filt else None
+            )
 
         levels = []
         for c in call.children:

@@ -93,16 +93,29 @@ def prepare_schema(cluster, config: WorkloadConfig) -> None:
 
 def preload(cluster, config: WorkloadConfig, bits: int = 4096) -> None:
     """Deterministic seed data so reads have something to find: zipfian
-    (row, col) pairs into the segmentation field."""
+    (row, col) pairs into the segmentation field, plus int values into
+    the BSI field so range_bsi predicates select non-empty rows from the
+    first request (not only after set_val writes accumulate)."""
     import numpy as np
 
-    from pilosa_tpu.loadgen.workload import Zipf
+    from pilosa_tpu.loadgen.workload import (
+        BSI_FIELD,
+        BSI_VAL_MAX,
+        BSI_VAL_MIN,
+        Zipf,
+    )
 
     rng = np.random.default_rng(config.seed ^ 0x5EED)
     rz = Zipf(config.n_rows, config.zipf_theta)
     cz = Zipf(config.n_cols, config.zipf_theta)
     pairs = [(rz.sample(rng), cz.sample(rng)) for _ in range(bits)]
     cluster.import_bits(config.index, "seg", pairs)
+    vcols = sorted({cz.sample(rng) for _ in range(bits // 2)})
+    vvals = [
+        int(v)
+        for v in rng.integers(BSI_VAL_MIN, BSI_VAL_MAX, size=len(vcols))
+    ]
+    cluster.import_values(config.index, BSI_FIELD, vcols, vvals)
 
 
 class _WorkerResult:

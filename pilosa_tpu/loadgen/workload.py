@@ -12,7 +12,11 @@ report reproducible and diffable across code changes.
 Op kinds map onto the server's SLO op classes (pilosa_tpu/obs/slo.py):
 
     count / row / topn / range_time / groupby  -> read.*
-    set / set_tq                               -> write
+    range_bsi                                  -> read.range, int-field
+                                                  predicates that ride
+                                                  the query-batched BSI
+                                                  lane
+    set / set_tq / set_val                     -> write
     key_set / key_count                        -> write / read.count,
                                                   via string keys (the
                                                   translation hot path)
@@ -34,13 +38,21 @@ TIME_BASE_MONTH = 1
 N_TQ_DAYS = 28
 N_TQ_HOURS = N_TQ_DAYS * 24
 
+# Int (BSI) field driven by range_bsi/set_val: bounds sized so the
+# depth matches a realistic metric column and predicates land in-band.
+BSI_FIELD = "val"
+BSI_VAL_MIN = -4096
+BSI_VAL_MAX = 4096
+
 DEFAULT_MIX: dict[str, float] = {
     "count": 22.0,
     "row": 8.0,
     "topn": 6.0,
-    "range_time": 10.0,
+    "range_time": 8.0,
+    "range_bsi": 6.0,
     "groupby": 4.0,
-    "set": 14.0,
+    "set": 12.0,
+    "set_val": 4.0,
     "set_tq": 12.0,
     "key_set": 8.0,
     "key_count": 8.0,
@@ -55,9 +67,11 @@ OP_CLASS: dict[str, str] = {
     "row": "read.row",
     "topn": "read.topn",
     "range_time": "read.range",
+    "range_bsi": "read.range",
     "groupby": "read.groupby",
     "set": "write",
     "set_tq": "write",
+    "set_val": "write",
     "key_set": "write",
     "key_count": "read.count",
     "translate": "translate",
@@ -214,6 +228,23 @@ class WorkloadGenerator:
                 kind, cfg.index,
                 f"Range(ev={r}, {self._ts(d1 * 24)}, {self._ts(d2 * 24)})",
             )
+        if kind == "range_bsi":
+            # Top-level Range() so obs/slo.py classifies it read.range;
+            # concurrent emitters coalesce into one query-batched BSI
+            # flight server-side (ops/bsi.py range_count_batch and kin).
+            b = int(rng.integers(BSI_VAL_MIN, BSI_VAL_MAX))
+            shape = rng.random()
+            if shape < 0.4:
+                pql = f"Range({BSI_FIELD} < {b})"
+            elif shape < 0.8:
+                pql = f"Range({BSI_FIELD} > {b})"
+            else:
+                span = int(rng.integers(1, (BSI_VAL_MAX - BSI_VAL_MIN) // 8))
+                pql = (
+                    f"Range({BSI_FIELD} >< "
+                    f"[{b}, {min(b + span, BSI_VAL_MAX)}])"
+                )
+            return self._query_op(kind, cfg.index, pql)
         if kind == "groupby":
             return self._query_op(kind, cfg.index, "GroupBy(Rows(seg), limit=8)")
         if kind == "set":
@@ -226,6 +257,12 @@ class WorkloadGenerator:
             hour = int(rng.integers(0, N_TQ_HOURS))
             return self._query_op(
                 kind, cfg.index, f"Set({c}, ev={r}, {self._ts(hour)})"
+            )
+        if kind == "set_val":
+            c = self._col_zipf.sample(rng)
+            v = int(rng.integers(BSI_VAL_MIN, BSI_VAL_MAX))
+            return self._query_op(
+                kind, cfg.index, f"Set({c}, {BSI_FIELD}={v})"
             )
         if kind == "key_set":
             k = self._key_zipf.sample(rng)
@@ -284,6 +321,11 @@ def schema_ops(config: WorkloadConfig) -> list[tuple[str, str, dict]]:
         ("index", config.index, {}),
         ("field", f"{config.index}/seg", {}),
         ("field", f"{config.index}/ev", {"type": "time", "timeQuantum": "YMD"}),
+        (
+            "field",
+            f"{config.index}/{BSI_FIELD}",
+            {"type": "int", "min": BSI_VAL_MIN, "max": BSI_VAL_MAX},
+        ),
         ("index", config.keys_index, {"keys": True}),
         ("field", f"{config.keys_index}/tag", {"keys": True}),
     ]

@@ -266,6 +266,425 @@ def min_max_host(planes, exists, sign, filter_words, *, depth: int, maximal: boo
     return value, int(cnt_b)
 
 
+# ---------------------------------------------------------------------------
+# Query-batched kernels: Q range predicates per launch.
+#
+# The single-query kernels above compile one program per (op, depth,
+# sign-variant) and pay a full dispatch per predicate — BENCH_r05 measured
+# that overhead drowning the engine (bsi_range_qps 206 vs the CPU path's
+# 7,100).  The batched forms lift the traced bound to stacked per-query
+# tensors so ONE launch evaluates a whole flight against shared
+# ``planes[S, depth, W]``:
+#
+# * every condition op shares ONE compiled program per (depth, Q-bucket,
+#   bound count, need): a query is 1-2 bounds, each encoded as per-plane
+#   magnitude-bit word masks plus a meta row of composition masks.  The
+#   comparison itself is two LSB-first borrow accumulators per bound —
+#   ``A`` (magnitude </<= bound) and ``B`` (magnitude >/>= bound), with
+#   strictness folded into the TRACED init word — and the value-space
+#   result (sign split, not-null fill, ==/!= via A&B) is selected by
+#   traced meta masks.  "<", "><", "!=", "==" are the same program with
+#   different traced inputs;
+# * a static ``need = (lo, hi)`` pair (a compile key) drops whichever
+#   accumulator no bound in the flight reads: a uniform "<=" flight runs
+#   one 4-op recurrence per plane instead of the full pair;
+# * Q pads to a power of two (padding queries select nothing), so
+#   drifting flight sizes reuse the same XLA program.
+# ---------------------------------------------------------------------------
+
+_ONES32 = np.uint32(0xFFFFFFFF)
+_KSHIFT = np.arange(64)  # plane-index shifts for magnitude-bit expansion
+_ZERO_META = [0] * 11    # shared all-zero meta row for padding slots
+
+# comparison ops consumable by encode_query_bounds; "any" is the
+# identity bound (matches every existing column).
+_CMP_OPS = ("<", "<=", ">", ">=", "==", "!=", "any")
+
+# qmeta channel indices (full-word masks unless noted)
+_M_A0 = 0      # lo accumulator init: 0 = strict (<), ONES = non-strict (<=)
+_M_B0 = 1      # hi accumulator init: 0 = strict (>), ONES = non-strict (>=)
+_M_OOB = 2     # |bound| >= 2^depth: forces A=ONES, B=0
+_M_FNEG = 3    # unconditionally include negative columns
+_M_FNON = 4    # unconditionally include non-negative columns
+_M_SNEG = 5    # apply the compare term to negative columns
+_M_SNON = 6    # apply the compare term to non-negative columns
+_M_XOR = 7     # invert the compare term (!=)
+_M_SELA = 8    # term reads A
+_M_SELB = 9    # term reads B
+_M_SELC = 10   # term reads A & B (==/!= equality)
+_M_CH = 11
+
+
+def condition_bounds(op: str, value) -> list[tuple[str, int]]:
+    """A PQL condition op as 1-2 ``(cmp, stored_bound)`` bounds consumable
+    by :func:`encode_query_bounds` (cmp one of ``_CMP_OPS``).  ``value``
+    is already base-adjusted (stored space).  ``!= None`` (not-null) is
+    the unconditional bound.  Raises ValueError for unsupported shapes."""
+    if op == "!=" and value is None:
+        return [("any", 0)]
+    if op in ("<", "<=", ">", ">=", "==", "!="):
+        if value is None:
+            raise ValueError(f"condition {op} requires a value")
+        return [(op, int(value))]
+    if op == "><":
+        lo, hi = value
+        return [(">=", int(lo)), ("<=", int(hi))]
+    if op in ("<x<", "<=x<", "<x<=", "<=x<="):
+        lo, hi = value
+        lo_op, hi_op = op.split("x")
+        return [
+            (">=" if lo_op == "<=" else ">", int(lo)),
+            ("<=" if hi_op == "<=" else "<", int(hi)),
+        ]
+    raise ValueError(f"unsupported condition op: {op}")
+
+
+def encode_query_bounds(queries, depth: int, q_pad: int | None = None):
+    """Pack per-query bound lists into the batched kernels' traced inputs:
+    ``(qmask[P,B,depth], qinv[P,B,depth], qmeta[P,B,11])`` uint32
+    full-word masks (0 / 0xFFFFFFFF), padded to ``q_pad`` queries (padding
+    rows select nothing).  ``qmask`` holds the bound magnitude bits as
+    per-plane words, ``qinv`` their complement (so the kernels' equality
+    term is a single xor), and ``qmeta`` the ``_M_*`` composition
+    channels.  Each query is a list of 1-2 ``(cmp, stored_bound)``
+    tuples; ``B`` is the flight's max bound count, so an all-single-bound
+    flight compiles the cheaper one-scan program.
+
+    Also returns ``need = (lo, hi)``: which borrow accumulators any bound
+    in the flight actually reads.  The pair is a compile key — a uniform
+    "<="/"<" flight never builds the hi-side recurrence.  Out-of-band
+    bounds (``|bound| >= 2^depth``) and the "any" identity read neither:
+    their result is decided by the meta masks alone."""
+    Q = len(queries)
+    P = Q if q_pad is None else q_pad
+    if P < Q:
+        raise ValueError("q_pad smaller than the query count")
+    for bounds in queries:
+        if not 1 <= len(bounds) <= 2:
+            raise ValueError("each query takes 1-2 bounds")
+    B = max((len(b) for b in queries), default=1)
+    # stage per-bound scalars in plain python (list sets are ~10x
+    # cheaper than numpy scalar assignment at flight sizes), then expand
+    # to full-word masks in one vectorized stroke per flight
+    mags = [0] * (P * B)
+    meta_rows = [_ZERO_META] * (P * B)
+    need_lo = need_hi = False
+    lim = 1 << depth
+    for qi, bounds in enumerate(queries):
+        for j in range(B):
+            # a missing second bound is the neutral "any" (r & exists)
+            cmp_, bound = bounds[j] if j < len(bounds) else ("any", 0)
+            meta = [0] * _M_CH
+            meta_rows[qi * B + j] = meta
+            if cmp_ == "any":
+                meta[_M_FNEG] = meta[_M_FNON] = 1
+                continue
+            if cmp_ not in _CMP_OPS:
+                raise ValueError(f"unsupported comparison: {cmp_}")
+            mag = abs(int(bound))
+            neg = bound < 0
+            oob = mag >= lim
+            if oob:
+                meta[_M_OOB] = 1
+            else:
+                mags[qi * B + j] = mag
+            meta[_M_SNEG if neg else _M_SNON] = 1
+            if cmp_ in ("==", "!="):
+                meta[_M_A0] = meta[_M_B0] = 1
+                meta[_M_SELC] = 1
+                if cmp_ == "!=":
+                    meta[_M_XOR] = 1
+                    meta[_M_FNON if neg else _M_FNEG] = 1
+                lo = hi = not oob
+            else:
+                # value-space </<= of a non-negative bound (or >/>= of a
+                # negative one) is the LO side of the magnitude compare;
+                # the mirrored cases are the HI side.  The opposite sign
+                # class matches unconditionally for </<= nonneg and >/>=
+                # neg (fill), and never otherwise.
+                lo = (cmp_[0] == "<") != neg
+                hi = not lo
+                if cmp_.endswith("="):
+                    meta[_M_A0 if lo else _M_B0] = 1
+                meta[_M_SELA if lo else _M_SELB] = 1
+                if cmp_[0] == ("<" if not neg else ">"):
+                    meta[_M_FNON if neg else _M_FNEG] = 1
+                lo, hi = lo and not oob, hi and not oob
+            need_lo = need_lo or lo
+            need_hi = need_hi or hi
+    # bit k of |bound| -> plane-k word all-ones
+    mag_arr = np.asarray(mags, np.int64).reshape(P, B, 1)
+    qmask = ((mag_arr >> _KSHIFT[:depth]) & 1).astype(np.uint32) * _ONES32
+    qmeta = np.asarray(meta_rows, np.uint32).reshape(P, B, _M_CH) * _ONES32
+    qinv = ~qmask
+    # padding rows keep qinv = ONES: the accumulators they drag along
+    # stay all-zero and the zero meta row selects nothing
+    return qmask, qinv, qmeta, (need_lo, need_hi)
+
+
+def _bound_term(planes, bm, binv, meta, depth: int, need):
+    """Compare term for one encoded bound, sign split not yet applied.
+    Two LSB-first borrow accumulators walk the planes — ``A`` =
+    magnitude </<= bound, ``B`` = magnitude >/>= bound, strictness
+    chosen by the traced init words — then the select masks compose
+    the ==/!= equality via ``A & B`` and the ``!=`` inversion."""
+    shape = planes.shape[:-2] + planes.shape[-1:]
+    A = jnp.broadcast_to(meta[_M_A0], shape)
+    Bm = jnp.broadcast_to(meta[_M_B0], shape)
+    for k in range(depth):  # LSB -> MSB: the last plane dominates
+        p = planes[..., k, :]
+        x = p ^ bm[k]  # plane bit != bound bit
+        # bm & ~p == bm & x and p & ~bm == x & binv, so each side is one
+        # xor + and + andnot + or per plane
+        if need[0]:
+            A = (bm[k] & x) | (A & ~x)
+        if need[1]:
+            Bm = (x & binv[k]) | (Bm & ~x)
+    A = A | meta[_M_OOB]       # oob bound exceeds every magnitude
+    Bm = Bm & ~meta[_M_OOB]
+    return meta[_M_XOR] ^ (
+        (meta[_M_SELA] & A)
+        | (meta[_M_SELB] & Bm)
+        | (meta[_M_SELC] & A & Bm)
+    )
+
+
+def _bound_eval(planes, neg_cols, nonneg_cols, bm, binv, meta, depth: int, need):
+    """Columns matching one encoded bound: the compare term applied to
+    its selected sign classes, plus the fill of the opposite class.
+    The encoder never fills and selects the SAME sign class, so the two
+    halves of the OR are disjoint — count-only callers exploit that."""
+    term = _bound_term(planes, bm, binv, meta, depth, need)
+    return (
+        (meta[_M_FNEG] & neg_cols)
+        | (meta[_M_FNON] & nonneg_cols)
+        | (((meta[_M_SNEG] & neg_cols) | (meta[_M_SNON] & nonneg_cols)) & term)
+    )
+
+
+def _query_eval(planes, neg_cols, nonneg_cols, mB, iB, tB, depth: int, need):
+    r = _bound_eval(planes, neg_cols, nonneg_cols, mB[0], iB[0], tB[0], depth, need)
+    for bi in range(1, mB.shape[0]):
+        r = r & _bound_eval(
+            planes, neg_cols, nonneg_cols, mB[bi], iB[bi], tB[bi], depth, need
+        )
+    return r
+
+
+@partial(jax.jit, static_argnames=("depth", "need"))
+def _range_batch_kernel(planes, exists, sign, qmask, qinv, qmeta, *, depth: int, need):
+    """[Q, ..., W] result masks for Q encoded range predicates in ONE
+    launch.  Compile key: (depth, Q-bucket, bound count, need, stack
+    shape)."""
+    neg_cols = exists & sign
+    nonneg_cols = exists & ~sign
+
+    def one(mB, iB, tB):
+        return _query_eval(planes, neg_cols, nonneg_cols, mB, iB, tB, depth, need)
+
+    return jax.vmap(one)(qmask, qinv, qmeta)
+
+
+def _count_one(planes, exists, sign, depth: int, need, n_bounds: int):
+    """Per-query count closure shared by the batched count kernels.
+    Single-bound flights skip materialising the fill half of the result
+    mask: fill and the selected compare classes are disjoint sign
+    classes by encoder construction, so the filled class contributes its
+    (shared, precomputed) column count as a scalar while only
+    ``sel & term`` is popcounted."""
+    neg_cols = exists & sign
+    nonneg_cols = exists & ~sign
+    if n_bounds == 1:
+        c_neg = jnp.sum(
+            lax.population_count(neg_cols).astype(jnp.int32), axis=-1
+        )
+        c_non = jnp.sum(
+            lax.population_count(nonneg_cols).astype(jnp.int32), axis=-1
+        )
+
+        def one(mB, iB, tB):
+            meta = tB[0]
+            term = _bound_term(planes, mB[0], iB[0], meta, depth, need)
+            sel = (meta[_M_SNEG] & neg_cols) | (meta[_M_SNON] & nonneg_cols)
+            cnt = jnp.sum(
+                lax.population_count(sel & term).astype(jnp.int32), axis=-1
+            )
+            cnt = cnt + jnp.where(meta[_M_FNEG] != 0, c_neg, 0)
+            return cnt + jnp.where(meta[_M_FNON] != 0, c_non, 0)
+
+        return one
+
+    def one(mB, iB, tB):
+        r = _query_eval(planes, neg_cols, nonneg_cols, mB, iB, tB, depth, need)
+        return jnp.sum(lax.population_count(r).astype(jnp.int32), axis=-1)
+
+    return one
+
+
+@partial(jax.jit, static_argnames=("depth", "need"))
+def _range_count_batch_kernel(planes, exists, sign, qmask, qinv, qmeta, *, depth: int, need):
+    """Per-query per-shard match counts ``int32[Q, S]``: vmap over the
+    query bucket with the word-axis popcount reduce fused into the same
+    launch, so the plane scans of the whole flight compile into one
+    elementwise program over the stack (word sums stay int32-exact per
+    shard; the host combines in int64)."""
+    one = _count_one(planes, exists, sign, depth, need, qmask.shape[1])
+    return jax.vmap(one)(qmask, qinv, qmeta)
+
+
+@partial(jax.jit, static_argnames=("depth", "need"))
+def _range_count_scan_kernel(planes, exists, sign, qmask, qinv, qmeta, *, depth: int, need):
+    """Scan-over-queries fallback for stacks where the vmap form's
+    [Q, S, W] intermediate would not fit comfortably: the working set
+    stays one mask wide at the cost of re-reading the planes per query."""
+    one = _count_one(planes, exists, sign, depth, need, qmask.shape[1])
+
+    def step(carry, q):
+        return carry, one(*q)
+
+    _, counts = lax.scan(step, 0, (qmask, qinv, qmeta))
+    return counts
+
+
+# above this many bytes of [Q-bucket, S, W] flight masks, batched counts
+# take the scan kernel (planes re-read per query, but no Q-wide state)
+_COUNT_BATCH_VMAP_LIMIT = 256 << 20
+
+
+def _batch_args(queries, depth: int):
+    from pilosa_tpu.ops.bitops import pow2_pad_len
+
+    P = pow2_pad_len(len(queries))
+    qmask, qinv, qmeta, need = encode_query_bounds(queries, depth, q_pad=P)
+    return (
+        jnp.asarray(qmask), jnp.asarray(qinv), jnp.asarray(qmeta),
+    ), need
+
+
+def range_batch(planes, exists, sign, queries, *, depth: int):
+    """Batched Range: ``masks[P, ..., W]`` for the encoded ``queries``
+    (list of bound lists, see :func:`condition_bounds`); the first
+    ``len(queries)`` slices are the per-query results, the pow2-padding
+    tail is garbage the caller must ignore."""
+    from pilosa_tpu.ops import kernels
+    import time
+
+    args = _batch_args(queries, depth)
+    t0 = time.perf_counter()
+    out = _range_batch_kernel(planes, exists, sign, *args[0], depth=depth, need=args[1])
+    kernels.note_bsi_dispatch(
+        "bsi_range_batch",
+        wall=time.perf_counter() - t0,
+        args=(planes, args[0][0]),
+        depth=depth,
+        q_bucket=int(args[0][0].shape[0]),
+        q_useful=len(queries),
+    )
+    return out
+
+
+def range_count_batch(planes, exists, sign, queries, *, depth: int):
+    """Batched Count(Range): per-query int64 match counts (host-side
+    exact sum of the per-shard int32 partials)."""
+    from pilosa_tpu.ops import kernels
+    import time
+
+    args, need = _batch_args(queries, depth)
+    P = int(args[0].shape[0])
+    mask_bytes = P * int(np.prod(exists.shape)) * 4
+    kern = (
+        _range_count_batch_kernel
+        if mask_bytes <= _COUNT_BATCH_VMAP_LIMIT
+        else _range_count_scan_kernel
+    )
+    t0 = time.perf_counter()
+    counts = kern(planes, exists, sign, *args, depth=depth, need=need)
+    kernels.note_bsi_dispatch(
+        "bsi_range_count_batch",
+        wall=time.perf_counter() - t0,
+        args=(planes, args[0]),
+        depth=depth,
+        q_bucket=P,
+        q_useful=len(queries),
+    )
+    arr = np.asarray(counts).astype(np.int64)
+    arr = arr.reshape(arr.shape[0], -1)
+    return [int(c) for c in arr.sum(axis=1)[: len(queries)]]
+
+
+# int32 ceiling for the fused Sum matmul accumulator: per-plane popcounts
+# accumulate ACROSS shards on device (unlike sum_count's per-shard
+# partials), so the total column count must fit int32.
+_SUM_BATCH_ACC_LIMIT = 2**31 - 1
+
+
+def sum_batch_supported(S: int, W: int) -> bool:
+    """Whether the fused batched Sum may accumulate across the whole
+    stack in int32 — the `row_counts_supported`-style decline gate;
+    callers fall back to the per-query host lane."""
+    return S * W * 32 <= _SUM_BATCH_ACC_LIMIT
+
+
+@jax.jit
+def _sum_batch_kernel(planes, exists, sign, filters):
+    """Fused popcount-reduction Sum over Q filters: gram-style int8
+    unpack + MXU matmul of [depth+1 rows] x [2Q filter rows] per shard,
+    accumulated over the shard scan — one launch answers every (plane,
+    filter, sign-class) popcount the place-value combine needs.
+    ``filters`` is ``uint32[S, Q, W]``; returns ``int32[depth+1, 2Q]``
+    (positive columns first, then negative; row depth = exists counts)."""
+    from pilosa_tpu.ops.kernels import _unpack_int8
+
+    f = filters & exists[:, None, :]
+    fpos = f & ~sign[:, None, :]
+    fneg = f & sign[:, None, :]
+    filt2 = jnp.concatenate([fpos, fneg], axis=1)  # [S, 2Q, W]
+    rows = jnp.concatenate([planes, exists[:, None, :]], axis=1)
+
+    def body(acc, sf):
+        r, ff = sf
+        g = lax.dot_general(
+            _unpack_int8(r), _unpack_int8(ff),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc + g, None
+
+    acc0 = jnp.zeros((rows.shape[1], filt2.shape[1]), jnp.int32)
+    acc, _ = lax.scan(body, acc0, (rows, filt2))
+    return acc
+
+
+def sum_batch_host(planes, exists, sign, filters, *, depth: int):
+    """Batched Sum host wrapper: ``[(sum, count), ...]`` per filter row.
+    ``filters`` is ``uint32[S, Q, W]`` (pass ``exists`` slices for
+    unfiltered queries); place-value combine in python ints so totals
+    past 2^63 stay exact."""
+    from pilosa_tpu.ops import kernels
+    import time
+
+    Q = int(filters.shape[1])
+    t0 = time.perf_counter()
+    acc = _sum_batch_kernel(planes, exists, sign, filters)
+    kernels.note_bsi_dispatch(
+        "bsi_sum_batch",
+        wall=time.perf_counter() - t0,
+        args=(planes, filters),
+        depth=depth,
+        q_bucket=Q,
+        q_useful=Q,
+    )
+    acc = np.asarray(acc).astype(np.int64)  # [depth+1, 2Q]
+    out = []
+    for q in range(Q):
+        pos, neg = acc[:, q], acc[:, Q + q]
+        total = sum(int(pos[k]) << k for k in range(depth)) - sum(
+            int(neg[k]) << k for k in range(depth)
+        )
+        out.append((total, int(pos[depth]) + int(neg[depth])))
+    return out
+
+
 def _exact_mag(planes, survivors, depth: int, approx: int) -> int:
     """extreme_mag tracks magnitude in int32; for depth >= 31 recompute the
     exact magnitude from one surviving column on the host."""

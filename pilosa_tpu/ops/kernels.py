@@ -190,19 +190,26 @@ def _note_dispatch(
     demoted: bool = False,
     padded_bytes: int = 0,
     useful_bytes: int = 0,
+    extra: dict | None = None,
+    extra_tags: tuple = (),
 ) -> None:
     """Record one kernel dispatch: tagged counters/timings into
     ``kernel_stats`` plus a per-kernel record into the active query
     profile.  ``wall`` is launch wall time — device work may still be in
     flight unless the caller synchronized.  The jit compile-cache
     hit/miss is a proxy: first sight of (kernel, lane, arg shapes) in
-    this process, mirroring XLA's shape-keyed jit cache."""
+    this process, mirroring XLA's shape-keyed jit cache.  ``extra``
+    merges lane-specific labels into the profile record and
+    ``extra_tags`` onto the dispatch counter (bounded cardinality is the
+    caller's responsibility)."""
     key = (kernel, lane, _shape_sig(args))
     with _dispatch_lock:
         miss = key not in _seen_programs
         if miss and len(_seen_programs) < _MAX_SEEN_PROGRAMS:
             _seen_programs.add(key)
-    tagged = kernel_stats.with_tags(f"kernel:{kernel}", f"lane:{lane}")
+    tagged = kernel_stats.with_tags(
+        f"kernel:{kernel}", f"lane:{lane}", *extra_tags
+    )
     tagged.count("kernel_dispatch")
     kernel_stats.count(
         "kernel_compile_misses" if miss else "kernel_compile_hits"
@@ -226,7 +233,44 @@ def _note_dispatch(
     if padded_bytes:
         rec["padded_bytes"] = int(padded_bytes)
         rec["useful_bytes"] = int(useful_bytes)
+    if extra:
+        rec.update(extra)
     qprofile.record_kernel(**rec)
+
+
+def note_bsi_dispatch(
+    kernel: str,
+    *,
+    wall: float,
+    args,
+    depth: int,
+    q_bucket: int,
+    q_useful: int,
+    lane: str = "xla",
+) -> None:
+    """BSI batched-lane dispatch: same pipeline as :func:`_note_dispatch`
+    but labelled with the lane's (depth, Q-bucket) compile key and the
+    padded-vs-useful query split, so the shape-keyed program cache the
+    batched kernels compile against is observable in ``?profile=true``
+    records and ``pilosa_kernel_*`` metrics."""
+    _note_dispatch(
+        kernel,
+        lane,
+        wall=wall,
+        args=args,
+        extra={"depth": int(depth), "qBucket": int(q_bucket),
+               "qUseful": int(q_useful)},
+        extra_tags=(f"depth:{depth}", f"qbucket:{q_bucket}"),
+    )
+    if q_bucket > q_useful:
+        # pow2 Q padding: queries, scaled to the per-query input bytes
+        tagged = kernel_stats.with_tags(f"kernel:{kernel}")
+        tagged.count("kernel_padded_queries", int(q_bucket - q_useful))
+        tagged.count("kernel_useful_queries", int(q_useful))
+    else:
+        kernel_stats.with_tags(f"kernel:{kernel}").count(
+            "kernel_useful_queries", int(q_useful)
+        )
 
 
 def note_transfer(nbytes: int, direction: str) -> None:

@@ -115,6 +115,17 @@ class InProcessCluster:
             },
         )
 
+    def import_values(
+        self, index: str, field: str, cols: list[int], values: list[int]
+    ) -> None:
+        """Route (col, value) pairs into an int field through node 0's
+        import coordinator (the BSI twin of :meth:`import_bits`)."""
+        self.nodes[0].api.import_bits(
+            index,
+            field,
+            {"columnIDs": list(cols), "values": list(values)},
+        )
+
     def owner_of(self, index: str, shard: int) -> NodeServer:
         node_id = self.nodes[0].cluster.primary_shard_node(index, shard).id
         for s in self.nodes:

@@ -1,0 +1,165 @@
+"""Cross-request BSI batch lane (executor._batch_bsi): grouped
+Range/Count/Sum/Min/Max/GroupBy flights must return exactly what the
+per-call path returns, share launches, and demux per-query errors."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.server.batcher import QueryBatcher
+
+PARTS = [
+    "Row(v < 100)",
+    "Row(v >= -50)",
+    "Row(v >< [-10, 10])",
+    "Row(v != 0)",
+    "Row(v != null)",
+    "Count(Row(v > 0))",
+    "Count(Row(v <= -200))",
+    "Sum(field=v)",
+    "Sum(Row(v > 0), field=v)",
+    "Sum(Row(v < 0), field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "GroupBy(Rows(seg), filter=Row(v > 200))",
+]
+
+
+@pytest.fixture()
+def setup():
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field(
+        "v", FieldOptions(field_type="int", min_=-1000, max_=1000)
+    )
+    idx.create_field("seg")
+    ex = Executor(h)
+    rng = np.random.default_rng(9)
+    writes = []
+    for c in rng.choice(40_000, size=600, replace=False):
+        writes.append(f"Set({int(c)}, v={int(rng.integers(-900, 900))})")
+    for c in rng.choice(40_000, size=250, replace=False):
+        writes.append(f"Set({int(c)}, seg={int(rng.integers(0, 4))})")
+    ex.execute("i", " ".join(writes))
+    return h, ex
+
+
+def _norm(r):
+    return sorted(r.columns()) if hasattr(r, "columns") else r
+
+
+def _per_call_results(h, parts):
+    """Ground truth through a fresh warm executor's per-call path."""
+    ex = Executor(h)
+    ex._BSI_SINGLE_WARM = 0
+    return [ex.execute("i", p)[0] for p in parts]
+
+
+def test_mixed_op_flight_matches_per_call(setup):
+    h, ex = setup
+    batched = ex.execute("i", " ".join(PARTS))
+    singles = _per_call_results(h, PARTS)
+    for p, a, b in zip(PARTS, batched, singles):
+        na, nb = _norm(a), _norm(b)
+        assert na == nb or str(na) == str(nb), p
+
+
+def test_flight_shares_launches(setup):
+    """5 range masks + 2 counts must not cost 7 dispatches: masks share
+    one launch, counts share one."""
+    _, ex = setup
+    mask_parts = PARTS[:5]
+    count_parts = PARTS[5:7]
+    ex.execute("i", " ".join(mask_parts))  # builds the stack
+    before = ex.bsi_stack_launches
+    ex.execute("i", " ".join(mask_parts + count_parts))
+    assert ex.bsi_stack_launches - before <= 2
+
+
+def test_execute_batch_parity_and_demux(setup):
+    h, ex = setup
+    queries = [(p, None) for p in PARTS]
+    queries.insert(3, ("Row(v == null)", None))  # invalid mid-flight
+    out = ex.execute_batch("i", queries)
+    bad = out.pop(3)
+    assert isinstance(bad, Exception)
+    singles = _per_call_results(h, PARTS)
+    for p, a, b in zip(PARTS, out, singles):
+        assert not isinstance(a, BaseException), (p, a)
+        na, nb = _norm(a[0]), _norm(b)
+        assert na == nb or str(na) == str(nb), p
+
+
+def test_cold_lone_range_stays_off_device(setup):
+    """A single cold Range must keep the per-call warm-up economics —
+    the batch lane engages only on >= 2 flight-mates or a live stack."""
+    h, _ = setup
+    ex = Executor(h)
+    before = ex.bsi_stack_launches
+    ex.execute("i", "Row(v < 5)")
+    assert ex.bsi_stack_launches == before
+
+
+def test_range_count_served_from_agg_cache(setup):
+    _, ex = setup
+    q = "Count(Row(v < 77)) Count(Row(v > 5))"
+    first = ex.execute("i", q)
+    before = ex.bsi_stack_launches
+    hits0 = ex.bsi_agg_cache_hits
+    second = ex.execute("i", q)
+    assert second == first
+    assert ex.bsi_stack_launches == before  # both served from cache
+    assert ex.bsi_agg_cache_hits > hits0
+
+
+def test_batcher_coalesces_concurrent_bsi_reads(setup):
+    """Concurrent single-query BSI requests through the serving plane
+    must share a flight (batch_size > 1) and demux per request."""
+    _, ex = setup
+    ex.execute("i", " ".join(PARTS[:2]))  # warm the stack
+    import pilosa_tpu.pql as pql
+
+    batcher = QueryBatcher(ex, window=0.05, max_batch=16)
+    try:
+        gate = threading.Barrier(6)
+        results: dict[int, object] = {}
+
+        def worker(k, q):
+            gate.wait(5)
+            try:
+                results[k] = batcher.submit("i", pql.parse(q))
+            except Exception as e:  # pragma: no cover - diagnostic
+                results[k] = e
+
+        qs = [
+            "Count(Row(v < 100))",
+            "Count(Row(v > 100))",
+            "Row(v >= 0)",
+            "Sum(field=v)",
+            "Count(Row(v < 100))",
+            "Min(field=v)",
+        ]
+        threads = [
+            threading.Thread(target=worker, args=(k, q))
+            for k, q in enumerate(qs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert batcher.coalesced > 1, batcher.snapshot()
+        for k, q in enumerate(qs):
+            assert not isinstance(results[k], BaseException), (q, results[k])
+        assert results[0] == results[4]
+        direct = [ex.execute("i", q)[0] for q in qs]
+        for k, q in enumerate(qs):
+            got = results[k][0]
+            assert _norm(got) == _norm(direct[k]) or str(got) == str(
+                direct[k]
+            ), q
+    finally:
+        batcher.close()

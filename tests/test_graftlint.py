@@ -119,6 +119,8 @@ class TestDispatchParity:
         )
         assert "parser special 'Zap'" in msgs
         assert "'/internal/orphan'" in msgs
+        assert "BSI op class BSI_ORPHAN" in msgs
+        assert "BSI op class BSI_RANGE" not in msgs
 
     def test_good_tree_clean(self):
         fs = engine.run([os.path.join(CORPUS, "dispatch_parity", "good")])

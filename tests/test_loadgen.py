@@ -101,6 +101,52 @@ def test_zipf_skews_toward_hot_ranks():
     assert max(samples) < 1000 and min(samples) >= 0
 
 
+def test_range_bsi_ops_emit_top_level_range_pql():
+    # top-level Range(...) is what obs/slo.py classifies as read.range;
+    # wrapping it (Count(Range(..))) would reclassify the query, so the
+    # generator must keep the call at the top level
+    from pilosa_tpu.loadgen.workload import BSI_FIELD, BSI_VAL_MAX, BSI_VAL_MIN
+
+    g = WorkloadGenerator(WorkloadConfig(seed=4))
+    ops = g.sequence(200, mix={"range_bsi": 1.0, "set_val": 1.0})
+    kinds = {op.kind for op in ops}
+    assert kinds == {"range_bsi", "set_val"}
+    shapes = set()
+    for op in ops:
+        body = op.body.decode()
+        if op.kind == "range_bsi":
+            assert op.op_class == "read.range"
+            assert body.startswith(f"Range({BSI_FIELD} ")
+            shapes.add(body.split(" ")[1])
+        else:
+            assert op.op_class == "write"
+            assert body.startswith("Set(") and f"{BSI_FIELD}=" in body
+            v = int(body.partition(f"{BSI_FIELD}=")[2].rstrip(")"))
+            assert BSI_VAL_MIN <= v < BSI_VAL_MAX
+    assert shapes == {"<", ">", "><"}  # 200 draws hit every predicate shape
+
+
+def test_schema_includes_bsi_int_field():
+    from pilosa_tpu.loadgen.workload import BSI_FIELD, schema_ops
+
+    cfg = WorkloadConfig(seed=1)
+    fields = {name: opts for kind, name, opts in schema_ops(cfg) if kind == "field"}
+    opts = fields[f"{cfg.index}/{BSI_FIELD}"]
+    assert opts["type"] == "int" and opts["min"] < 0 < opts["max"]
+
+
+def test_default_stage_plan_has_range_heavy_stage():
+    from tools.loadharness import RANGE_HEAVY_MIX, default_stages
+
+    stages = default_stages(duration=8.0, rate=100.0, workers=4)
+    [rs] = [s for s in stages if s.name == "rangescan"]
+    assert rs.mix is RANGE_HEAVY_MIX
+    # range reads dominate the stage, with value writes interleaved
+    assert max(RANGE_HEAVY_MIX, key=RANGE_HEAVY_MIX.get) == "range_bsi"
+    assert RANGE_HEAVY_MIX["set_val"] > 0
+    assert {OP_CLASS[k] for k in RANGE_HEAVY_MIX} >= {"read.range", "write"}
+
+
 def test_time_quantum_ops_carry_timestamps():
     g = WorkloadGenerator(WorkloadConfig(seed=2))
     ops = g.sequence(50, mix={"set_tq": 1.0, "range_time": 1.0})
@@ -210,3 +256,23 @@ def test_short_harness_run_emits_valid_report():
     # the server saw the same classes the client drove
     for cls in report["ops"]:
         assert report["serverSLO"]["classes"][cls]["total"] > 0
+
+
+def test_range_heavy_harness_run_serves_read_range():
+    # the range-heavy mix must reach the server as read.range and come
+    # back clean: preloaded int values make the predicates non-trivial,
+    # and any server-side rejection of the Range PQL would surface as
+    # op errors here
+    cfg = WorkloadConfig(seed=11, n_cols=5_000)
+    report = run_harness(
+        cfg,
+        [StageSpec("rangescan", 1.0, 40.0, 3,
+                   {"range_bsi": 3.0, "set_val": 1.0})],
+        nodes=1,
+        preload_bits=256,
+    )
+    validate_report(report)
+    assert report["clientErrors"] == 0
+    rr = report["ops"]["read.range"]
+    assert rr["count"] > 0 and rr["errors"] == 0
+    assert report["serverSLO"]["classes"]["read.range"]["total"] >= rr["count"]

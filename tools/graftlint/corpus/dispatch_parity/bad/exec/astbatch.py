@@ -1,0 +1,10 @@
+"""Mini astbatch: signs 'bsi.orphan' flights the executor never serves."""
+
+BSI_RANGE = "bsi.range"
+BSI_ORPHAN = "bsi.orphan"
+
+
+def sign(call):
+    if call.name == "Row":
+        return BSI_RANGE
+    return BSI_ORPHAN

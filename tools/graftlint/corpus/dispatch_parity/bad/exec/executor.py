@@ -1,4 +1,6 @@
-"""Mini executor: only handles Set."""
+"""Mini executor: only handles Set; serves only the bsi.range flights."""
+
+from . import astbatch
 
 
 def _execute_call(self, idx, call, shards):
@@ -6,3 +8,7 @@ def _execute_call(self, idx, call, shards):
     if name == "Set":
         return self._execute_set(idx, call)
     raise ValueError(f"unknown call: {name}")
+
+
+def _batch_bsi(self, groups):
+    return groups.get(astbatch.BSI_RANGE, [])

@@ -1,0 +1,8 @@
+"""Mini astbatch: every signed BSI op class has an executor consumer."""
+
+BSI_RANGE = "bsi.range"
+BSI_SUM = "bsi.sum"
+
+
+def sign(call):
+    return BSI_RANGE if call.name == "Row" else BSI_SUM

@@ -1,4 +1,6 @@
-"""Mini executor handling every parser special."""
+"""Mini executor handling every parser special and signed BSI class."""
+
+from . import astbatch
 
 
 def _execute_call(self, idx, call, shards):
@@ -8,3 +10,8 @@ def _execute_call(self, idx, call, shards):
     if call.name in ("TopN", "Rows"):
         return self._execute_topn(idx, call, shards)
     raise ValueError(f"unknown call: {name}")
+
+
+def _batch_bsi(self, groups):
+    for cls in (astbatch.BSI_RANGE, astbatch.BSI_SUM):
+        yield groups.get(cls, [])

@@ -1,6 +1,7 @@
 """dispatch-parity: parser/executor and route/client surfaces must agree.
 
-Two cross-file invariants the round-5 review kept re-checking by hand:
+Three cross-file invariants the round-5/9 reviews kept re-checking by
+hand:
 
 * every special call form the PQL parser recognizes (the ``specials``
   dict in pql/parser.py) must have a handler in exec/executor.py's
@@ -10,11 +11,15 @@ Two cross-file invariants the round-5 review kept re-checking by hand:
   table in server/http.py) must have a matching InternalClient method
   in cluster/client.py — an uncallable internal endpoint is dead
   surface, and an unserved client path is a cluster-wide 404 at the
-  worst possible time (resize, anti-entropy).
+  worst possible time (resize, anti-entropy);
+* every BSI batch op class exec/astbatch.py signs queries into (the
+  ``BSI_* = "bsi...."`` constants) must be consumed by the executor's
+  cross-request batch lane — a signed-but-unserved class routes
+  flights into a group ``_batch_bsi`` silently never answers.
 
-This is a project-wide pass: it locates the four role files by their
-path suffixes under the linted roots, so it works unchanged on the
-bundled corpus mini-trees.
+This is a project-wide pass: it locates the role files by their path
+suffixes under the linted roots, so it works unchanged on the bundled
+corpus mini-trees.
 """
 
 from __future__ import annotations
@@ -32,6 +37,7 @@ _PARSER_SUFFIX = "pql/parser.py"
 _EXECUTOR_SUFFIX = "exec/executor.py"
 _HTTP_SUFFIX = "server/http.py"
 _CLIENT_SUFFIX = "cluster/client.py"
+_ASTBATCH_SUFFIX = "exec/astbatch.py"
 
 
 def applies(path: str) -> bool:  # unused for project passes; kept uniform
@@ -123,6 +129,37 @@ def _client_paths(tree: ast.AST) -> set[str]:
     return out
 
 
+# -- part C: astbatch BSI op classes vs executor batch lane -----------------
+
+
+def _bsi_op_classes(tree: ast.AST) -> dict[str, int]:
+    """{constant name: line} for ``BSI_X = "bsi...."`` module constants."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id.startswith("BSI_")):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ) and node.value.value.startswith("bsi."):
+            out[t.id] = node.lineno
+    return out
+
+
+def _executor_bsi_refs(tree: ast.AST) -> set[str]:
+    """BSI_* names the executor reads, as ``astbatch.BSI_X`` attributes
+    or bare imported names."""
+    refs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr.startswith("BSI_"):
+            refs.add(node.attr)
+        elif isinstance(node, ast.Name) and node.id.startswith("BSI_"):
+            refs.add(node.id)
+    return refs
+
+
 def check_project(files: dict) -> list[Finding]:
     findings: list[Finding] = []
 
@@ -155,6 +192,20 @@ def check_project(files: dict) -> list[Finding]:
                         http_path, line, 0, PASS_ID,
                         f"internal route {route!r} has no cluster/client.py "
                         "method: dead endpoint or an unreachable peer call",
+                    )
+                )
+
+    astbatch_path, astbatch_tree = _find(files, _ASTBATCH_SUFFIX)
+    if astbatch_tree is not None and executor_tree is not None:
+        refs = _executor_bsi_refs(executor_tree)
+        for name, line in sorted(_bsi_op_classes(astbatch_tree).items()):
+            if name not in refs:
+                findings.append(
+                    Finding(
+                        astbatch_path, line, 0, PASS_ID,
+                        f"BSI op class {name} is signed by astbatch but "
+                        "never consumed by the executor batch lane: "
+                        "flights routed there are silently unserved",
                     )
                 )
     return findings

@@ -8,6 +8,8 @@ Default stage plan (scaled by --duration/--rate/--workers):
 
     warm        read-heavy mix at half rate/concurrency
     timequantum streaming timestamped SetBit + concurrent Range reads
+    rangescan   int-field range predicates (the query-batched BSI lane)
+                with interleaved value writes
     ramp        full mix at full rate and concurrency
 
 Examples::
@@ -51,14 +53,23 @@ TIMEQUANTUM_MIX = {
     "set_tq": 45.0, "range_time": 30.0, "count": 10.0, "set": 5.0,
     "key_set": 5.0, "translate": 5.0,
 }
+# Range-heavy: concurrent int-field predicates coalesce into
+# query-batched BSI flights server-side, so the per-round SLO verdict
+# regresses read.range at batched-lane throughput; interleaved set_val
+# writes keep the field's device stack churning under the reads.
+RANGE_HEAVY_MIX = {
+    "range_bsi": 42.0, "set_val": 18.0, "count": 12.0, "row": 8.0,
+    "groupby": 6.0, "set": 8.0, "translate": 6.0,
+}
 
 
 def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
-    third = max(1.0, duration / 3.0)
+    quarter = max(1.0, duration / 4.0)
     return [
-        StageSpec("warm", third, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
-        StageSpec("timequantum", third, rate, workers, TIMEQUANTUM_MIX),
-        StageSpec("ramp", third, rate * 1.5, workers, None),
+        StageSpec("warm", quarter, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", quarter, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("rangescan", quarter, rate, workers, RANGE_HEAVY_MIX),
+        StageSpec("ramp", quarter, rate * 1.5, workers, None),
     ]
 
 

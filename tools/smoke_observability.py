@@ -12,7 +12,10 @@ asserts the three operator-visible planes work over actual HTTP:
 * ``/debug/fragments`` reports the written fragment's storage detail;
 * a concurrent query burst rides the continuous-batching serving plane
   (``pilosa_batcher_*`` in ``/metrics``, a ``batcher`` block in
-  ``/debug/vars``, ``batcher.queueWait`` attribution in the profile).
+  ``/debug/vars``, ``batcher.queueWait`` attribution in the profile);
+* a concurrent int-field burst coalesces into query-batched BSI
+  flights (batcher ``coalesced`` advances; the batched range-count
+  kernel shows up in the dispatch telemetry).
 
 Exit status 0 on success; any assertion/exception fails the CI step.
 Run as ``python -m tools.smoke_observability``.
@@ -131,6 +134,52 @@ def main() -> int:
         names = [c["name"] for c in resp["profile"]["tree"]["children"]]
         assert "batcher.queueWait" in names, names
         assert "batcher.dispatch" in names, names
+
+        # -- query-batched BSI lane: a concurrent int-field burst must
+        # coalesce into flights (batch_size > 1) answered by the shared
+        # slice-plane launches
+        _post(
+            f"{base}/index/smoke/field/v",
+            b'{"options": {"type": "int", "min": -1000, "max": 1000}}',
+            "application/json",
+        )
+        sets = " ".join(
+            f"Set({c}, v={(c * 37) % 900 - 450})" for c in range(64)
+        )
+        _post(f"{base}/index/smoke/query", sets.encode())
+        # two flight-mates in one request warm the field's device stack,
+        # so the burst's lone reads stay batch-eligible
+        _post(
+            f"{base}/index/smoke/query",
+            b"Count(Row(v < 0)) Count(Row(v > 0))",
+        )
+        coalesced0 = json.loads(_get(f"{base}/debug/vars"))["batcher"][
+            "coalesced"
+        ]
+
+        def _bsi_client(k: int) -> None:
+            try:
+                for j in range(8):
+                    q = f"Count(Row(v < {k * 50 + j - 400}))".encode()
+                    out = json.loads(_post(f"{base}/index/smoke/query", q))
+                    assert isinstance(out["results"][0], int), out
+            except Exception as e:
+                burst_errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=_bsi_client, args=(k,), daemon=True)
+            for k in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not burst_errors, burst_errors[:3]
+
+        vars_ = json.loads(_get(f"{base}/debug/vars"))
+        assert vars_["batcher"]["coalesced"] > coalesced0, vars_["batcher"]
+        metrics = _get(f"{base}/metrics").decode()
+        assert "bsi_range_count_batch" in metrics, metrics[:400]
     finally:
         node.stop()
     print("observability smoke OK")
