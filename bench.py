@@ -52,6 +52,9 @@ import numpy as np
 # ends) after the parent dies.  stderr goes to a temp FILE for the same
 # reason: a pipe would block past the timeout waiting for EOF.
 _PROBE_ATTEMPTS = []
+# Warning lines the probe prints to stderr; folded into the result JSON
+# so a CPU-fallback round is self-describing without bench_err.txt.
+_PROBE_WARNINGS: list[str] = []
 _PROBE_BACKOFFS = (0, 15, 30, 60, 120, 240)
 _PROBE_TIMEOUT = 180
 
@@ -114,11 +117,13 @@ def _accelerator_alive() -> bool:
             rec["stderr_tail"] = errf.read().decode("utf-8", "replace")[-400:]
         rec["secs"] = round(time.time() - t0, 1)
         _PROBE_ATTEMPTS.append(rec)
-        print(
+        msg = (
             f"accelerator probe attempt {attempt + 1}/{len(_PROBE_BACKOFFS)}: "
-            f"rc={rec['rc']} after {rec['secs']}s (backoff {backoff}s)",
-            file=sys.stderr,
+            f"rc={rec['rc']} after {rec['secs']}s (backoff {backoff}s)"
         )
+        if rec["rc"] != 0:
+            _PROBE_WARNINGS.append(msg)
+        print(msg, file=sys.stderr)
         if rec["rc"] == 0:
             return True
     return False
@@ -135,6 +140,7 @@ if _FORCED_CPU:
     # sitecustomize may pin the accelerator platform at import; the env
     # var alone does not override it.
     jax.config.update("jax_platforms", "cpu")
+    _PROBE_WARNINGS.append("accelerator unreachable, benchmarking on CPU")
     print(
         "warning: accelerator unreachable, benchmarking on CPU",
         file=sys.stderr,
@@ -344,6 +350,86 @@ def _served_concurrency_sweep() -> dict:
         }
     finally:
         srv.stop()
+
+
+def _recorder_overhead_lane() -> dict:
+    """Flight-recorder overhead lane (BENCH_r06 follow-up): the same
+    single-client served query loop against two freshly booted nodes —
+    one with the always-on incident plane live (flight recorder sampling
+    stacks + tail-sampled trace store observing every request, the
+    serving default) and one with both off — so the JSON pins what the
+    observability plane costs the hot path.  Target: <= 5% qps."""
+    import http.client
+
+    from pilosa_tpu.server.node import NodeServer
+
+    def boot(recorder: bool):
+        srv = NodeServer(port=0, flight_recorder=recorder)
+        srv.start()
+        api = srv.api
+        if not recorder:
+            # tail sampling off too: a None store makes the span
+            # sink and the per-request store binding no-ops
+            api.holder.traces = None
+        api.create_index("rec")
+        api.create_field("rec", "f")
+        rng = np.random.default_rng(13)
+        width = api.holder.n_words * 32
+        writes = [
+            f"Set({int(c)}, f={row})"
+            for row in range(4)
+            for c in rng.integers(0, width, size=150)
+        ]
+        api.query("rec", " ".join(writes))
+        conn = http.client.HTTPConnection(
+            srv.host, srv.server.port, timeout=60
+        )
+        body = b"Count(Intersect(Row(f=0), Row(f=1)))"
+
+        def once() -> None:
+            conn.request("POST", "/index/rec/query", body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"recorder lane HTTP {resp.status}: {data[:120]!r}"
+                )
+
+        return srv, conn, once
+
+    # Single-client qps drifts +-10% run to run on a shared host, so the
+    # two configs are measured in INTERLEAVED blocks and compared on
+    # their best block — drift hits both sides, the best block of each
+    # is the closest thing to the machine's uncontended service rate.
+    srv_on, conn_on, once_on = boot(True)
+    srv_off, conn_off, once_off = boot(False)
+    try:
+        for once in (once_on, once_off):
+            for _ in range(50):
+                once()
+        reps, best_on, best_off = 200, 0.0, 0.0
+        for _ in range(5):
+            for once, which in ((once_off, "off"), (once_on, "on")):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    once()
+                qps = reps / (time.perf_counter() - t0)
+                if which == "on":
+                    best_on = max(best_on, qps)
+                else:
+                    best_off = max(best_off, qps)
+        conn_on.close()
+        conn_off.close()
+    finally:
+        srv_on.stop()
+        srv_off.stop()
+    return {
+        "qps_recorder_on": round(best_on, 1),
+        "qps_recorder_off": round(best_off, 1),
+        "overhead_frac": (
+            round(1.0 - best_on / best_off, 4) if best_off else None
+        ),
+    }
 
 
 def _np_bsi_lt(planes, exists, sign, value, depth):
@@ -709,6 +795,14 @@ def main() -> None:
     # -- served concurrency sweep: the continuous-batching plane through
     # the real HTTP listener (one keep-alive connection per client)
     served_sweep = _served_concurrency_sweep()
+
+    # -- flight-recorder overhead: served qps with the incident plane
+    # on vs off (the lane must never sink the bench)
+    recorder_lane = None
+    try:
+        recorder_lane = _recorder_overhead_lane()
+    except Exception as e:
+        print(f"warning: recorder overhead lane failed: {e}", file=sys.stderr)
 
     # -- SLO harness lane: a short seeded mixed-workload burst through
     # the full HTTP path with the server's error-budget tracker live
@@ -1209,7 +1303,12 @@ def main() -> None:
         # SLO harness lane (short seeded mixed burst; the full report is
         # in the SLO_r*.json it writes — see docs/observability.md)
         "slo_harness": slo_lane,
+        # incident-plane cost: overhead_frac is (1 - on/off); the
+        # acceptance bar for the always-on recorder is <= 0.05
+        "recorder_overhead": recorder_lane,
         "probe": _PROBE_ATTEMPTS,
+        "probe_warnings": _PROBE_WARNINGS,
+        "forced_cpu": _FORCED_CPU,
         # dispatch-lane / compile-cache / transfer accounting for the
         # whole run: says WHICH lane produced the numbers above (a
         # pallas-demoted round is not comparable to a pallas round)

@@ -322,6 +322,12 @@ class InternalClient:
                 self._breakers[netloc] = br
             return br
 
+    def breaker_states(self) -> dict[str, str]:
+        """Current per-peer breaker state by netloc (flight-recorder
+        segment field: breaker flaps line up with latency segments)."""
+        with self._breakers_lock:
+            return {n: br.state for n, br in self._breakers.items()}
+
     def peer_available(self, uri: str) -> bool:
         """Advisory routing check: False while ``uri``'s breaker is open
         (and not yet due for a half-open probe).  ``dist`` consults this
@@ -599,6 +605,17 @@ class InternalClient:
         fans out through here)."""
         return self._json("GET", uri, f"/debug/events?since={int(since)}")
 
+    def debug_traces(self, uri: str, limit: int = 100) -> dict:
+        """Pull a peer's kept-trace summaries (cluster trace list)."""
+        return self._json("GET", uri, f"/debug/traces?limit={int(limit)}")
+
+    def debug_trace_spans(self, uri: str, trace_id: str) -> dict:
+        """Pull the spans a peer holds for one trace id (cluster trace
+        assembly) — kept or merely recent on that node."""
+        return self._json(
+            "GET", uri, f"/debug/traces?id={trace_id}&spans=true"
+        )
+
     def shards_max(self, uri: str) -> dict:
         """Per-index max shard seen by ``uri`` (reference
         client.go:176 MaxShardByIndex)."""
@@ -692,6 +709,15 @@ class NopInternalClient:
 
     def debug_events(self, uri, since=0):
         return {"events": [], "nextSeq": since, "truncated": False}
+
+    def debug_traces(self, uri, limit=100):
+        return {"traces": []}
+
+    def debug_trace_spans(self, uri, trace_id):
+        return {"spans": []}
+
+    def breaker_states(self):
+        return {}
 
     def shards_max(self, uri):
         return {}

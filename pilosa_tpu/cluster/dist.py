@@ -385,7 +385,12 @@ class DistributedExecutor:
         own profile dict in the response envelope, and we hang it off the
         current span so ``?profile=true`` shows the whole cluster tree."""
         want = qprofile.profiling()
-        with qprofile.span("fanout", node=node_id, shards=len(shards)):
+        # real tracing span (not just a profile node): the remote node's
+        # http.query span parents to THIS span, so a cluster-assembled
+        # trace shows coordinator -> fanout -> peer as one tree
+        fanout = tracing.start_span("dist.fanout")
+        fanout.set_tag("peer", node_id).set_tag("shards", len(shards))
+        with fanout, qprofile.span("fanout", node=node_id, shards=len(shards)):
             resp = self.client.query_node(
                 uri, index_name, pql_text, shards, profile=want
             )

@@ -28,12 +28,18 @@ class Holder:
         from pilosa_tpu.obs.events import EventJournal
         from pilosa_tpu.obs.jobs import JobTracker
         from pilosa_tpu.obs.slo import SLOTracker
+        from pilosa_tpu.obs.tracestore import TraceStore
 
         self.events = EventJournal()
         self.jobs = JobTracker()
         # SLO plane: per-op-class latency quantiles + error budgets,
         # recorded at the HTTP boundary, served at /debug/slo.
         self.slo = SLOTracker()
+        # Trace plane: tail-sampled per-node trace store (/debug/traces);
+        # slow-keep thresholds come from the SLO latency objectives, and
+        # kept traces feed the SLO histogram's bucket exemplars.
+        self.traces = TraceStore(slo=self.slo)
+        self.traces.on_keep = self.slo.attach_exemplar
 
     def set_stats(self, client: stats_mod.StatsClient) -> None:
         """Install a stats client, re-tagging existing indexes/fields the

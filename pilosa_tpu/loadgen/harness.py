@@ -267,6 +267,7 @@ class LoadHarness:
         client_errors = sum(out.client_errors for out in results)
         server_slo = _fetch_json(self.uris[0], "/debug/slo")
         metrics_text = _fetch_text(self.uris[0], "/metrics")
+        incidents = _fetch_json(self.uris[0], "/debug/incidents")
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -277,6 +278,7 @@ class LoadHarness:
             server_slo=server_slo,
             live_slo_ok=bool(live_snapshot and live_snapshot.get("classes") is not None),
             slo_metrics_present="pilosa_slo_requests_total" in metrics_text,
+            incidents=incidents,
         )
 
 

@@ -33,6 +33,7 @@ def build_report(
     server_slo: dict | None,
     live_slo_ok: bool,
     slo_metrics_present: bool,
+    incidents: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -88,6 +89,9 @@ def build_report(
         "serverSLO": server_slo,
         "liveSLOServedDuringRun": live_slo_ok,
         "sloMetricsPresent": slo_metrics_present,
+        # flight-recorder view after the run: incident bundles captured
+        # by burning alerts / 504 spikes during the fault stages
+        "incidents": (incidents or {}).get("incidents", []),
         "verdicts": verdicts,
         "pass": overall,
     }

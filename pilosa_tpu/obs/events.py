@@ -43,6 +43,7 @@ EVENT_ANTIENTROPY_ROUND = "antientropy-round"
 EVENT_CIRCUIT_BREAKER = "circuit-breaker"
 EVENT_SNAPSHOT = "snapshot"              # fragment op-log compaction
 EVENT_FAULT_INJECTED = "fault-injected"  # testing/faults.py rule fired
+EVENT_INCIDENT = "incident"              # flight recorder auto-capture
 
 
 class EventJournal:

@@ -27,7 +27,11 @@ def _otlp_span(span) -> dict:
     if start_ns is None:  # foreign span object without the anchor
         start_ns = int(time.time_ns() - (time.monotonic() - span.start) * 1e9)
     dur_ns = int((span.duration or 0.0) * 1e9)
+    # OTLP status from the error tag the HTTP layer stamps before
+    # finish: 2 = STATUS_CODE_ERROR, 0 = STATUS_CODE_UNSET
+    status = {"code": 2} if span.tags.get("error") else {"code": 0}
     return {
+        "status": status,
         "traceId": f"{span.context.trace_id & (2**128 - 1):032x}",
         "spanId": f"{span.context.span_id & (2**64 - 1):016x}",
         "parentSpanId": (

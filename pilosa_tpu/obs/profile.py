@@ -36,6 +36,47 @@ def _collapse(frame) -> str:
     return ";".join(reversed(parts))
 
 
+class Sampler:
+    """Incremental all-thread stack sampler: call :meth:`tick` at any
+    cadence (the blocking :func:`sample` loop, or the flight recorder's
+    segment thread), :meth:`drain` to take the accumulated collapse and
+    reset.  One tick walks ``sys._current_frames()`` once — the
+    Google-Wide-Profiling shape: always-on because each observation is
+    O(live threads), not O(wall time)."""
+
+    def __init__(self, exclude_ident: int | None = None):
+        self._exclude = exclude_ident
+        self._names: dict[int | None, str] = {}
+        self._stacks: Counter[str] = Counter()
+        self._per_thread: Counter[str] = Counter()
+        self.samples = 0
+
+    def tick(self) -> None:
+        for t in threading.enumerate():
+            self._names[t.ident] = t.name
+        me = threading.get_ident()
+        for ident, frame in sys._current_frames().items():
+            if ident == me or ident == self._exclude:
+                continue  # the sampler itself is noise
+            self._stacks[_collapse(frame)] += 1
+            self._per_thread[self._names.get(ident, str(ident))] += 1
+        self.samples += 1
+
+    def drain(self, top: int | None = None) -> dict:
+        """Take {"samples", "stacks", "threads"} and reset the counters;
+        ``top`` bounds the stack list (segment records keep only the
+        hottest stacks)."""
+        out = {
+            "samples": self.samples,
+            "stacks": dict(self._stacks.most_common(top)),
+            "threads": dict(self._per_thread.most_common()),
+        }
+        self._stacks.clear()
+        self._per_thread.clear()
+        self.samples = 0
+        return out
+
+
 def sample(
     seconds: float, interval: float = 0.005, max_seconds: float = 30.0
 ) -> dict:
@@ -43,29 +84,14 @@ def sample(
     {"samples": N, "seconds": s, "interval_s": i,
      "stacks": {collapsed_stack: count}, "threads": {name: count}}."""
     seconds = max(0.05, min(float(seconds), max_seconds))
-    me = threading.get_ident()
-    names = {}
-    stacks: Counter[str] = Counter()
-    per_thread: Counter[str] = Counter()
-    n = 0
+    s = Sampler()
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
-        for t in threading.enumerate():
-            names[t.ident] = t.name
-        for ident, frame in sys._current_frames().items():
-            if ident == me:
-                continue  # the sampler itself is noise
-            stacks[_collapse(frame)] += 1
-            per_thread[names.get(ident, str(ident))] += 1
-        n += 1
+        s.tick()
         time.sleep(interval)
-    return {
-        "samples": n,
-        "seconds": seconds,
-        "interval_s": interval,
-        "stacks": dict(stacks.most_common()),
-        "threads": dict(per_thread.most_common()),
-    }
+    out = s.drain()
+    out.update(seconds=seconds, interval_s=interval)
+    return out
 
 
 def memory_snapshot(holder=None) -> dict:

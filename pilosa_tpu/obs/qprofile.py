@@ -98,6 +98,17 @@ class QueryProfile:
         self._lock = threading.Lock()
         self._kernel_records = 0
         self._kernel_dropped = 0
+        # ambient trace id (32-hex) captured at collection start: links
+        # each slow-query-log entry to its /debug/traces record (lazy
+        # import — tracing imports this module)
+        from pilosa_tpu.obs import tracing
+
+        span = tracing.active_span()
+        self.trace_id: str | None = (
+            f"{span.context.trace_id & (2**128 - 1):032x}"
+            if span is not None
+            else None
+        )
 
     def finish(self, elapsed: float, error: str | None = None) -> None:
         self.duration_ms = elapsed * 1e3
@@ -112,6 +123,8 @@ class QueryProfile:
             "duration_ms": self.duration_ms,
             "tree": self.root.to_dict(),
         }
+        if self.trace_id is not None:
+            d["traceId"] = self.trace_id
         if self.error is not None:
             d["error"] = self.error
         if self._kernel_dropped:

@@ -272,12 +272,24 @@ def _quantile(buckets: list[int], q: float) -> float | None:
 
 
 class _ClassState:
-    __slots__ = ("total", "errors", "ring")
+    __slots__ = ("total", "errors", "ring", "lat_buckets", "lat_sum",
+                 "exemplars")
 
     def __init__(self, slot_seconds: float, max_window: float):
         self.total = 0
         self.errors = 0
         self.ring = _Ring(max_window, slot_seconds)
+        # lifetime (non-windowed) duration histogram for the Prometheus
+        # exposition — monotone, so scrapers can rate() it; the windowed
+        # ring stays the quantile source.  Per-bucket counts, cumulated
+        # at render time.
+        self.lat_buckets = [0] * _N_BUCKETS
+        self.lat_sum = 0.0
+        # per-bucket (trace_id_hex, seconds, unix_ts): most recent trace
+        # the tail sampler KEPT that landed in this bucket
+        self.exemplars: list[tuple[str, float, float] | None] = (
+            [None] * _N_BUCKETS
+        )
 
 
 class SLOTracker:
@@ -333,6 +345,23 @@ class SLOTracker:
             if error:
                 st.errors += 1
             st.ring.observe(now, error, bucket)
+            st.lat_buckets[bucket] += 1
+            st.lat_sum += seconds
+
+    def attach_exemplar(
+        self, op_class: str, seconds: float, trace_id: str
+    ) -> None:
+        """Record a tail-KEPT trace as the exemplar for its latency
+        bucket (wired from TraceStore.on_keep): /metrics bucket lines
+        then point at a trace /debug/traces can actually serve."""
+        bucket = _bucket_of(seconds)
+        with self._lock:
+            st = self._classes.get(op_class)
+            if st is None:
+                st = self._classes[op_class] = _ClassState(
+                    self.slot_seconds, self._max_window
+                )
+            st.exemplars[bucket] = (trace_id, seconds, time.time())
 
     # -- exposition ----------------------------------------------------
 
@@ -446,11 +475,13 @@ class SLOTracker:
             "burnRules": snap["burnRules"],
         }
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplar_filter=None) -> str:
         """``pilosa_slo_*`` series for the /metrics scrape.  Rendered
         directly from the tracker (no MemStatsClient round trip): the
         windowed gauges are recomputed at scrape time and the counters
-        are monotone from the lifetime totals."""
+        are monotone from the lifetime totals.  With ``exemplar_filter``
+        the per-class duration histogram carries OpenMetrics
+        ``# {trace_id="..."}`` exemplars for tail-kept traces."""
         snap = self.snapshot()
         out: list[str] = []
 
@@ -515,6 +546,32 @@ class SLOTracker:
                     f'pilosa_slo_alert{{class="{name}",rule="{rule}"}}'
                     f" {1 if firing else 0}"
                 )
+        # Lifetime per-class duration histogram (distinct name from the
+        # pilosa_slo_latency_seconds quantile gauges above): the series
+        # that carries bucket exemplars pointing into /debug/traces.
+        from pilosa_tpu.obs.stats import exemplar_suffix
+
+        with self._lock:
+            hist = {
+                name: (list(st.lat_buckets), st.lat_sum, list(st.exemplars))
+                for name, st in self._classes.items()
+            }
+        typ("pilosa_slo_request_duration_seconds", "histogram")
+        base = "pilosa_slo_request_duration_seconds"
+        for name in sorted(hist):
+            buckets, total, exemplars = hist[name]
+            cum = 0
+            for i, bound in enumerate(LATENCY_BOUNDS):
+                cum += buckets[i]
+                ex = exemplar_suffix(exemplars[i], exemplar_filter)
+                out.append(
+                    f'{base}_bucket{{class="{name}",le="{bound}"}} {cum}{ex}'
+                )
+            cum += buckets[-1]
+            ex = exemplar_suffix(exemplars[-1], exemplar_filter)
+            out.append(f'{base}_bucket{{class="{name}",le="+Inf"}} {cum}{ex}')
+            out.append(f'{base}_count{{class="{name}"}} {cum}')
+            out.append(f'{base}_sum{{class="{name}"}} {total}')
         return "\n".join(out) + "\n"
 
 

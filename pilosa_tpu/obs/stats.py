@@ -17,7 +17,19 @@ the interface point where it would plug in is the same.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterable
+
+from pilosa_tpu.obs import tracing
+
+
+def _ambient_trace_id() -> str | None:
+    """The active span's trace id (32-hex) — the exemplar candidate a
+    histogram observation records for its bucket."""
+    span = tracing.active_span()
+    if span is None:
+        return None
+    return f"{span.context.trace_id & (2**128 - 1):032x}"
 
 
 class StatsClient:
@@ -85,7 +97,7 @@ HISTOGRAM_BUCKETS = (
 
 
 class _Histo:
-    __slots__ = ("count", "total", "min", "max", "buckets")
+    __slots__ = ("count", "total", "min", "max", "buckets", "exemplars")
 
     def __init__(self):
         self.count = 0
@@ -93,17 +105,30 @@ class _Histo:
         self.min = float("inf")
         self.max = float("-inf")
         self.buckets = [0] * len(HISTOGRAM_BUCKETS)
+        # per-bucket exemplar candidate (trace_id_hex, value, unix_ts);
+        # index len(HISTOGRAM_BUCKETS) is the +Inf bucket.  "Candidate"
+        # because keep/drop is the trace store's tail decision — the
+        # renderer filters against the kept set at scrape time.
+        self.exemplars: list[tuple[str, float, float] | None] = [None] * (
+            len(HISTOGRAM_BUCKETS) + 1
+        )
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: str | None = None) -> None:
         self.count += 1
         self.total += v
         if v < self.min:
             self.min = v
         if v > self.max:
             self.max = v
+        tight = len(HISTOGRAM_BUCKETS)  # +Inf unless a bound catches v
         for i, bound in enumerate(HISTOGRAM_BUCKETS):
             if v <= bound:
                 self.buckets[i] += 1
+                if i < tight:
+                    tight = i
+        if trace_id is not None:
+            # tightest bucket only (OpenMetrics: one exemplar per bucket)
+            self.exemplars[tight] = (trace_id, v, time.time())
 
     def to_dict(self) -> dict:
         buckets = {
@@ -169,11 +194,19 @@ class MemStatsClient(StatsClient):
 
     def histogram(self, name, value):
         k = self._key(name)
+        trace_id = _ambient_trace_id()
         with self._lock:
             h = self._histograms.get(k)
             if h is None:
                 h = self._histograms[k] = _Histo()
-            h.observe(value)
+            h.observe(value, trace_id)
+
+    def get_counter(self, name: str, tags: Iterable[str] = ()) -> float:
+        """Current value of one counter (0.0 when never incremented) —
+        the flight recorder diffs these per segment."""
+        k = self._key(name, tags)
+        with self._lock:
+            return self._counters.get(k, 0)
 
     def set_value(self, name, value):
         k = self._key(name)
@@ -301,9 +334,28 @@ def _prom_le_labels(tags: tuple[str, ...], bound) -> str:
     return "{" + ",".join(parts) + "}"
 
 
-def prometheus_text(client: StatsClient) -> str:
+def exemplar_suffix(
+    ex: tuple[str, float, float] | None, exemplar_filter
+) -> str:
+    """OpenMetrics exemplar suffix for one bucket line, or "" — only
+    exemplars whose trace survived tail sampling are exposed (the filter
+    is membership in the trace store's kept set).  ``None`` filter means
+    exemplars are off (plain exposition, the pre-exemplar output)."""
+    if ex is None or exemplar_filter is None:
+        return ""
+    trace_id, value, ts = ex
+    if not exemplar_filter(trace_id):
+        return ""
+    return f' # {{trace_id="{trace_id}"}} {value} {round(ts, 3)}'
+
+
+def prometheus_text(client: StatsClient, exemplar_filter=None) -> str:
     """Render a MemStatsClient in Prometheus text exposition format
-    (reference prometheus/prometheus.go:52, route http/handler.go:282)."""
+    (reference prometheus/prometheus.go:52, route http/handler.go:282).
+    With ``exemplar_filter`` (a trace-id predicate), histogram bucket
+    lines carry OpenMetrics ``# {trace_id="..."}`` exemplars for kept
+    traces, so an operator jumps from a latency bucket straight to
+    ``/debug/traces?id=``."""
     if not isinstance(client, MemStatsClient):
         return ""
     out: list[str] = []
@@ -311,7 +363,7 @@ def prometheus_text(client: StatsClient) -> str:
         counters = dict(client._counters)
         gauges = dict(client._gauges)
         histos = {
-            k: (h.count, h.total, list(h.buckets))
+            k: (h.count, h.total, list(h.buckets), list(h.exemplars))
             for k, h in client._histograms.items()
         }
         sets = {k: len(s) for k, s in client._sets.items()}
@@ -330,12 +382,16 @@ def prometheus_text(client: StatsClient) -> str:
         n = "pilosa_" + _prom_name(name)
         typ(n, "gauge")
         out.append(f"{n}{_prom_labels(tags)} {v}")
-    for (name, tags), (cnt, total, buckets) in sorted(histos.items()):
+    for (name, tags), (cnt, total, buckets, exemplars) in sorted(
+        histos.items()
+    ):
         n = "pilosa_" + _prom_name(name)
         typ(n, "histogram")
-        for bound, bcnt in zip(HISTOGRAM_BUCKETS, buckets):
-            out.append(f"{n}_bucket{_prom_le_labels(tags, bound)} {bcnt}")
-        out.append(f'{n}_bucket{_prom_le_labels(tags, "+Inf")} {cnt}')
+        for i, (bound, bcnt) in enumerate(zip(HISTOGRAM_BUCKETS, buckets)):
+            ex = exemplar_suffix(exemplars[i], exemplar_filter)
+            out.append(f"{n}_bucket{_prom_le_labels(tags, bound)} {bcnt}{ex}")
+        ex = exemplar_suffix(exemplars[-1], exemplar_filter)
+        out.append(f'{n}_bucket{_prom_le_labels(tags, "+Inf")} {cnt}{ex}')
         out.append(f"{n}_count{_prom_labels(tags)} {cnt}")
         out.append(f"{n}_sum{_prom_labels(tags)} {total}")
     for (name, tags), card in sorted(sets.items()):

@@ -20,7 +20,7 @@ network egress; spans can be dumped for offline analysis).
 from __future__ import annotations
 
 import contextvars
-import itertools
+import random
 import threading
 import time
 from collections import deque
@@ -29,21 +29,65 @@ from pilosa_tpu.obs import qprofile
 
 TRACE_HEADER = "X-Pilosa-Trace-Id"
 SPAN_HEADER = "X-Pilosa-Span-Id"
+TRACEPARENT_HEADER = "traceparent"
 
-_ids = itertools.count(1)
+# Id minting (W3C trace-context widths: 128-bit trace ids, 64-bit span
+# ids).  A per-process RNG — NOT a counter — so two nodes never mint the
+# same trace id; ``seed_ids`` re-seeds it for deterministic tests.
+_id_lock = threading.Lock()
+_id_rng = random.Random()
+
+
+def seed_ids(seed: int | None) -> None:
+    """Re-seed the id generator (tests); ``None`` restores entropy."""
+    with _id_lock:
+        _id_rng.seed(seed)
+
+
+def _new_trace_id() -> int:
+    with _id_lock:
+        while True:
+            tid = _id_rng.getrandbits(128)
+            if tid:  # the zero id is invalid on the wire (W3C §3.2.2.3)
+                return tid
+
+
+def _new_span_id() -> int:
+    with _id_lock:
+        while True:
+            sid = _id_rng.getrandbits(64)
+            if sid:
+                return sid
+
+
 _active_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "pilosa_active_span", default=None
 )
 
+# Optional span sink: called with every finished span AFTER the tracer's
+# own ``_record``.  This is how the per-node TraceStore observes spans
+# without replacing the configured tracer (obs/tracestore.py installs
+# itself here at import-time of the store module).
+_span_sink = None
+
+
+def set_span_sink(sink) -> None:
+    global _span_sink
+    _span_sink = sink
+
 
 class SpanContext:
-    """Wire-propagatable identity of a span."""
+    """Wire-propagatable identity of a span.  ``remote`` marks a context
+    extracted from incoming headers: a span whose parent is remote is a
+    *local root* — the first span of this trace on this node — which is
+    where tail-sampling decisions attach."""
 
-    __slots__ = ("trace_id", "span_id")
+    __slots__ = ("trace_id", "span_id", "remote")
 
-    def __init__(self, trace_id: int, span_id: int):
+    def __init__(self, trace_id: int, span_id: int, remote: bool = False):
         self.trace_id = trace_id
         self.span_id = span_id
+        self.remote = remote
 
 
 class Span:
@@ -53,8 +97,11 @@ class Span:
         self.tracer = tracer
         self.name = name
         self.parent_id = parent.span_id if parent else 0
-        trace_id = parent.trace_id if parent else next(_ids)
-        self.context = SpanContext(trace_id, next(_ids))
+        # local root = no parent at all, or a parent extracted from the
+        # wire (the first span of the trace on THIS node)
+        self.local_root = parent is None or parent.remote
+        trace_id = parent.trace_id if parent else _new_trace_id()
+        self.context = SpanContext(trace_id, _new_span_id())
         self.start = time.monotonic()
         # wall-clock anchor, taken once at span start: exporters must not
         # re-derive it at export time (batched exports would skew it)
@@ -75,6 +122,8 @@ class Span:
         if self.duration is None:
             self.duration = time.monotonic() - self.start
             self.tracer._record(self)
+            if _span_sink is not None:
+                _span_sink(self)
 
     # context-manager + ambient-activation protocol.  Every span is
     # also mirrored into the active QueryProfile (if any) — this runs
@@ -106,20 +155,23 @@ class Tracer:
         return Span(self, name, child_of)
 
     def inject_headers(self, ctx: SpanContext, headers: dict) -> None:
-        """opentracing.go:58-66 InjectHTTPHeaders."""
+        """opentracing.go:58-66 InjectHTTPHeaders — native headers plus a
+        W3C ``traceparent`` (version 00, sampled flag set) for interop."""
         headers[TRACE_HEADER] = str(ctx.trace_id)
         headers[SPAN_HEADER] = str(ctx.span_id)
+        headers[TRACEPARENT_HEADER] = format_traceparent(ctx)
 
     def extract_headers(self, headers) -> SpanContext | None:
-        """opentracing.go:68-76 ExtractHTTPHeaders."""
+        """opentracing.go:68-76 ExtractHTTPHeaders.  Native headers win;
+        falls back to W3C ``traceparent``."""
         trace_id = headers.get(TRACE_HEADER)
         span_id = headers.get(SPAN_HEADER)
-        if not trace_id or not span_id:
-            return None
-        try:
-            return SpanContext(int(trace_id), int(span_id))
-        except ValueError:
-            return None
+        if trace_id and span_id:
+            try:
+                return SpanContext(int(trace_id), int(span_id), remote=True)
+            except ValueError:
+                return None
+        return parse_traceparent(headers.get(TRACEPARENT_HEADER))
 
     def _record(self, span: Span) -> None:
         pass
@@ -181,6 +233,35 @@ class ExportingTracer(RecordingTracer):
 
     def close(self) -> None:
         self.exporter.close()
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    """W3C trace-context header: 00-<32hex trace>-<16hex span>-<flags>."""
+    return f"00-{ctx.trace_id & (2**128 - 1):032x}-{ctx.span_id & (2**64 - 1):016x}-01"
+
+
+def parse_traceparent(value) -> SpanContext | None:
+    """Parse a W3C ``traceparent`` header; ``None`` on anything invalid
+    (wrong field widths, non-hex, all-zero ids, reserved version ff)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_hex, span_hex = parts[0], parts[1], parts[2]
+    if len(version) != 2 or len(trace_hex) != 32 or len(span_hex) != 16:
+        return None
+    if version.lower() == "ff":
+        return None
+    try:
+        int(version, 16)
+        trace_id = int(trace_hex, 16)
+        span_id = int(span_hex, 16)
+    except ValueError:
+        return None
+    if not trace_id or not span_id:
+        return None
+    return SpanContext(trace_id, span_id, remote=True)
 
 
 # Global tracer (reference tracing.GlobalTracer :22-29).

@@ -110,6 +110,9 @@ class API:
         # Diagnostics collector; NodeServer installs one (reference
         # server.go diagnostics wiring).
         self.diagnostics = None
+        # Flight recorder + incident engine; NodeServer installs one
+        # (obs/flightrec.py) — None means /debug/incidents serves empty.
+        self.flightrec = None
         # Bounded import worker pool: concurrency limit + backpressure
         # (reference api.go:66-96 importWorkerPoolSize default 2,
         # importWorker :313-348; both knobs configurable like the
@@ -941,6 +944,92 @@ class API:
         """Live per-op-class objective state (/debug/slo)."""
         return self.holder.slo.snapshot()
 
+    # -- trace plane (tail-sampled store, /debug/traces) --------------------
+
+    def traces_snapshot(self, limit: int = 100) -> dict:
+        """This node's kept-trace summaries + store counters."""
+        store = self.holder.traces
+        return {
+            "traces": store.summaries(limit),
+            "store": store.snapshot(),
+        }
+
+    def trace_detail(self, trace_id: str) -> dict | None:
+        """One kept trace's spans (local view); None when not kept."""
+        return self.holder.traces.detail(trace_id)
+
+    def cluster_traces(self, limit: int = 100) -> dict:
+        """Kept-trace summaries from every node, merged newest-first
+        (same fan-out contract as :meth:`cluster_events`: unreachable
+        peers are reported, not fatal)."""
+        per_node = [self.holder.traces.summaries(limit)]
+        unreachable = []
+        if self.cluster is not None and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.node_id or not node.uri:
+                    continue
+                try:
+                    remote = self.client.debug_traces(node.uri, limit=limit)
+                except Exception as e:
+                    unreachable.append({"node": node.id, "error": str(e)})
+                    continue
+                per_node.append(remote.get("traces", []))
+        merged = [t for traces in per_node for t in traces]
+        merged.sort(key=lambda t: t.get("at", 0.0), reverse=True)
+        return {
+            "traces": merged[:limit],
+            "nodes": len(per_node),
+            "unreachable": unreachable,
+        }
+
+    def cluster_trace(self, trace_id: str) -> dict:
+        """Assemble ONE trace cluster-wide: ask every node for the spans
+        it holds under this trace id (kept or merely recent — a fast
+        remote leg of a slow coordinator trace lives only in the peer's
+        recent tier) and merge them into one span list."""
+        spans = list(self.holder.traces.spans_for(trace_id))
+        detail = self.holder.traces.detail(trace_id)
+        nodes = 1
+        unreachable = []
+        if self.cluster is not None and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.node_id or not node.uri:
+                    continue
+                try:
+                    remote = self.client.debug_trace_spans(node.uri, trace_id)
+                except Exception as e:
+                    unreachable.append({"node": node.id, "error": str(e)})
+                    continue
+                spans.extend(remote.get("spans", []))
+                nodes += 1
+        spans.sort(key=lambda s: (s.get("startUnixMs", 0), s.get("node", "")))
+        out = {
+            "traceId": trace_id,
+            "spans": spans,
+            "nodes": nodes,
+            "unreachable": unreachable,
+        }
+        if detail is not None:
+            out["summary"] = {k: v for k, v in detail.items() if k != "spans"}
+        return out
+
+    def trace_spans(self, trace_id: str) -> dict:
+        """Local spans for one trace id (the peer leg of
+        :meth:`cluster_trace`)."""
+        return {"spans": self.holder.traces.spans_for(trace_id)}
+
+    # -- incident plane (flight recorder, /debug/incidents) -----------------
+
+    def incidents_snapshot(self) -> dict:
+        if self.flightrec is None:
+            return {"enabled": False, "incidents": []}
+        return self.flightrec.incidents_snapshot()
+
+    def incident_detail(self, incident_id: str) -> dict | None:
+        if self.flightrec is None:
+            return None
+        return self.flightrec.incident_detail(incident_id)
+
     def fragment_details(
         self, index: str | None = None, field: str | None = None
     ) -> dict:
@@ -1293,6 +1382,8 @@ class API:
             self.store.sync()
 
     def close(self) -> None:
+        if self.flightrec is not None:
+            self.flightrec.stop()
         if self.batcher is not None:
             self.batcher.close()  # drains the admission queue first
         self.ingest.close()  # flush pending device uploads

@@ -114,6 +114,7 @@ class QueryBatcher:
         self._lock = threading.Lock()
         self._closed = False
         self._depth = 0  # submitted, not yet demuxed (includes in-flight)
+        self._depth_peak = 0  # high-water mark since last take_depth_peak
         self.dispatched = 0  # flights dispatched (observability)
         self.coalesced = 0  # requests that shared a flight with >=1 other
         self._thread = threading.Thread(
@@ -144,6 +145,8 @@ class QueryBatcher:
             direct = self._closed
             if not direct:
                 self._depth += 1
+                if self._depth > self._depth_peak:
+                    self._depth_peak = self._depth
                 if self.stats is not None:
                     self.stats.gauge("batcher_depth", self._depth)
                 # put under the lock (never blocks: unbounded queue) so
@@ -308,6 +311,15 @@ class QueryBatcher:
                     item.batch_profile = prof_dict
 
     # -- lifecycle / introspection ------------------------------------------
+
+    def take_depth_peak(self) -> int:
+        """Depth high-water mark since the last call, then reset — the
+        flight recorder's per-segment congestion signal (the live gauge
+        misses bursts shorter than a scrape interval)."""
+        with self._lock:
+            peak = self._depth_peak
+            self._depth_peak = self._depth
+            return peak
 
     def snapshot(self) -> dict:
         """Serving-plane block for /debug/vars."""

@@ -35,7 +35,7 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
-from pilosa_tpu.obs import slo, tracing
+from pilosa_tpu.obs import slo, tracestore, tracing
 from pilosa_tpu.server.api import API, ApiError
 
 logger = logging.getLogger(__name__)
@@ -66,6 +66,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
     ("GET", re.compile(r"^/debug/memory$"), "debug_memory"),
     ("GET", re.compile(r"^/debug/events$"), "debug_events"),
+    ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/debug/incidents$"), "debug_incidents"),
     ("GET", re.compile(r"^/debug/jobs$"), "debug_jobs"),
     ("GET", re.compile(r"^/debug/fragments$"), "debug_fragments"),
     ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),  # graftlint: disable=dispatch-parity -- operator debug endpoint (curl/monitoring), never called node-to-node
@@ -173,6 +175,12 @@ class Handler(BaseHTTPRequestHandler):
             match = rx.match(parsed.path)
             if match:
                 t0 = time.monotonic()
+                # Route this request's spans into THIS node's trace
+                # store (contextvar: in-process multi-node clusters share
+                # the process-global tracer but not their stores).
+                store_token = tracestore._active_store.set(
+                    getattr(self.api.holder, "traces", None)
+                )
                 # Join an incoming cross-node trace, or root a new one
                 # (reference http/handler.go extracts opentracing headers).
                 parent = tracing.get_tracer().extract_headers(self.headers)
@@ -182,8 +190,13 @@ class Handler(BaseHTTPRequestHandler):
                 # (deadline/batcher expiry) and 500s burn budget; 4xx
                 # client mistakes don't.
                 slo_error = False
+                # span lifecycle is manual (not `with span:`) so the
+                # op-class and error verdict — known only after the
+                # handler ran — are tagged BEFORE finish(): the tail-
+                # sampling decision at root completion reads both.
+                span.__enter__()
                 try:
-                    with deadline.scope(self._request_budget()), span:
+                    with deadline.scope(self._request_budget()):
                         getattr(self, "r_" + name)(**match.groupdict())
                 except DeadlineExceeded as e:
                     # Distinct from ApiError (400-family): a spent budget
@@ -208,6 +221,11 @@ class Handler(BaseHTTPRequestHandler):
                     op_class = slo.take_class() or _SLO_ROUTE_CLASS.get(
                         name, slo.OP_OTHER
                     )
+                    span.set_tag("op_class", op_class)
+                    if slo_error:
+                        span.set_tag("error", True)
+                    span.__exit__(None, None, None)
+                    tracestore._active_store.reset(store_token)
                     self.api.holder.slo.observe(op_class, elapsed, slo_error)
                     self.api.holder.stats.count_with_tags(
                         "http_requests", 1, 1.0, (f"route:{name}",)
@@ -268,11 +286,16 @@ class Handler(BaseHTTPRequestHandler):
         # Kernel + key-translation telemetry live in process-global
         # registries (visible under NopStatsClient holders); the SLO
         # plane renders its own pilosa_slo_* series from the tracker.
+        # Histogram buckets expose OpenMetrics exemplars filtered to
+        # traces the tail sampler actually kept, so every exemplar id
+        # resolves at /debug/traces?id=.
+        kept = self.api.holder.traces.kept_ids()
+        filt = kept.__contains__
         text = (
-            prometheus_text(self.api.holder.stats)
-            + prometheus_text(kernels.kernel_stats)
+            prometheus_text(self.api.holder.stats, exemplar_filter=filt)
+            + prometheus_text(kernels.kernel_stats, exemplar_filter=filt)
             + prometheus_text(translate.translate_stats)
-            + self.api.holder.slo.prometheus_text()
+            + self.api.holder.slo.prometheus_text(exemplar_filter=filt)
         )
         self._send(
             200,
@@ -339,6 +362,55 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, self.api.events_since(since, limit))
 
+    def r_debug_traces(self):
+        """Tail-sampled trace store: kept-trace list, ?id=<32hex> span
+        detail, ?cluster=true coordinator fan-out (with id: assemble one
+        trace's spans from every node; without: merge kept summaries)."""
+        trace_id = self.query_params.get("id", [None])[0]
+        try:
+            limit = int(self.query_params.get("limit", ["100"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "bad limit"})
+            return
+        if self.query_params.get("cluster", ["false"])[0].lower() in (
+            "1", "true", "yes",
+        ):
+            if trace_id:
+                self._send_json(200, self.api.cluster_trace(trace_id))
+            else:
+                self._send_json(200, self.api.cluster_traces(limit))
+            return
+        if trace_id:
+            if self.query_params.get("spans", ["false"])[0].lower() in (
+                "1", "true", "yes",
+            ):
+                # peer leg of cluster assembly: raw local spans, kept
+                # OR recent, 200 even when empty
+                self._send_json(200, self.api.trace_spans(trace_id))
+                return
+            detail = self.api.trace_detail(trace_id)
+            if detail is None:
+                self._send_json(404, {"error": f"trace {trace_id} not kept"})
+            else:
+                self._send_json(200, detail)
+            return
+        self._send_json(200, self.api.traces_snapshot(limit))
+
+    def r_debug_incidents(self):
+        """Flight-recorder incident bundles (alert-edge / 504-spike
+        auto-captures): list, or full bundle with ?id=."""
+        incident_id = self.query_params.get("id", [None])[0]
+        if incident_id:
+            detail = self.api.incident_detail(incident_id)
+            if detail is None:
+                self._send_json(
+                    404, {"error": f"incident {incident_id} not found"}
+                )
+            else:
+                self._send_json(200, detail)
+            return
+        self._send_json(200, self.api.incidents_snapshot())
+
     def r_debug_jobs(self):
         """Background-job records: active + bounded history, with phase,
         progress counters, rates and ETA (?kind= filters)."""
@@ -384,6 +456,8 @@ class Handler(BaseHTTPRequestHandler):
         flamegraph-collapsed stacks — the net/http/pprof profile-
         endpoint role (reference http/handler.go:280).  The request
         thread does the sampling; the threaded server keeps serving."""
+        import math
+
         from pilosa_tpu.obs import profile
 
         try:
@@ -391,14 +465,23 @@ class Handler(BaseHTTPRequestHandler):
             interval = (
                 float(self.query_params.get("interval_ms", ["5"])[0]) / 1e3
             )
-            if not (seconds == seconds and interval == interval):  # NaN
+            if not (math.isfinite(seconds) and math.isfinite(interval)):
+                raise ValueError
+            if seconds <= 0 or interval <= 0:
                 raise ValueError
         except ValueError:
             self._send_json(400, {"error": "bad seconds/interval_ms"})
             return
-        # clamp BOTH ways: a huge/inf interval would park this server
-        # thread in time.sleep far past the seconds cap
+        # clamp BOTH ways: a huge interval would park this server thread
+        # in time.sleep far past the seconds cap
         interval = min(max(0.001, interval), 1.0)
+        # The sampler blocks this request thread for the whole window:
+        # cap it by the caller's remaining deadline budget (at 90%, so
+        # serialization still fits) instead of sampling into a 504.
+        deadline.check("debug/profile")
+        rem = deadline.remaining()
+        if rem is not None:
+            seconds = min(seconds, max(0.05, rem * 0.9))
         self._send_json(200, profile.sample(seconds, interval))
 
     def r_debug_memory(self):

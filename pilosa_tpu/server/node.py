@@ -62,6 +62,13 @@ class NodeServer:
         slo_burn_rules: list[dict] | None = None,
         slo_slot_seconds: float | None = None,
         slo_latency_window: float | None = None,
+        trace_store_capacity: int = 256,
+        trace_baseline_n: int = 128,
+        flight_recorder: bool = True,
+        flightrec_segment_seconds: float = 1.0,
+        flightrec_sample_interval: float = 0.025,
+        flightrec_segments: int = 60,
+        flightrec_spike_504: int = 5,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -108,15 +115,22 @@ class NodeServer:
                     else 300.0
                 ),
             )
+            # re-point the trace store at the replacement tracker (its
+            # slow-keep thresholds + exemplar sink live there)
+            self.holder.traces.slo = self.holder.slo
+            self.holder.traces.on_keep = self.holder.slo.attach_exemplar
+        self.holder.traces.capacity = max(1, int(trace_store_capacity))
+        self.holder.traces.baseline_n = int(trace_baseline_n)
         self.store = None
         if data_dir is not None:
             self.store = HolderStore(self.holder, data_dir)
             self.store.open()
         node_id = self.store.node_id() if self.store else uuid.uuid4().hex
-        # Event journal / job tracker carry this node's id on every
-        # record (the cluster timeline merge keys on it).
+        # Event journal / job tracker / trace store carry this node's id
+        # on every record (the cluster merges key on it).
         self.holder.events.node_id = node_id
         self.holder.jobs.node_id = node_id
+        self.holder.traces.node_id = node_id
         self.cluster = Cluster(node_id, replica_n=replica_n, disabled=True)
         # Every cluster-state transition — local or applied from a peer's
         # broadcast — lands on the timeline.
@@ -183,6 +197,23 @@ class NodeServer:
             self.holder, self.cluster, version=__version__
         )
         self.api.diagnostics = self.diagnostics
+        # Flight recorder + incident engine (obs/flightrec.py): always-on
+        # segment ring, SLO-alert/504-spike auto-capture at
+        # /debug/incidents.  start()/stop() ride the node lifecycle.
+        self.flightrec = None
+        if flight_recorder:
+            from pilosa_tpu.obs.flightrec import FlightRecorder
+
+            self.flightrec = FlightRecorder(
+                self.holder,
+                api=self.api,
+                client=self.client,
+                segment_seconds=flightrec_segment_seconds,
+                sample_interval=flightrec_sample_interval,
+                segments=flightrec_segments,
+                spike_504=flightrec_spike_504,
+            )
+            self.api.flightrec = self.flightrec
         self.gc_notifier = GCNotifier()
         self.runtime_monitor = RuntimeMonitor(
             self.holder.stats,
@@ -257,6 +288,8 @@ class NodeServer:
         self.server.serve_background()
         self.cluster.local_node.uri = self.uri
         self.runtime_monitor.start()
+        if self.flightrec is not None:
+            self.flightrec.start()
         self.holder.events.record(
             ev.EVENT_NODE_START, uri=self.uri, state=self.api.state
         )
@@ -384,6 +417,8 @@ class NodeServer:
             self.membership.stop()
         if self.api.dist is not None:
             self.api.dist.close()
+        if self.flightrec is not None:
+            self.flightrec.stop()
         self.runtime_monitor.stop()
         self.diagnostics.stop()
         self.gc_notifier.close()

@@ -36,6 +36,13 @@ class InProcessCluster:
         slo_slot_seconds: float | None = None,
         slo_latency_window: float | None = None,
         default_deadline: float = 0.0,
+        trace_store_capacity: int = 256,
+        trace_baseline_n: int = 128,
+        flight_recorder: bool = True,
+        flightrec_segment_seconds: float = 1.0,
+        flightrec_sample_interval: float = 0.025,
+        flightrec_segments: int = 60,
+        flightrec_spike_504: int = 5,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -50,6 +57,13 @@ class InProcessCluster:
             "slo_slot_seconds": slo_slot_seconds,
             "slo_latency_window": slo_latency_window,
             "default_deadline": default_deadline,
+            "trace_store_capacity": trace_store_capacity,
+            "trace_baseline_n": trace_baseline_n,
+            "flight_recorder": flight_recorder,
+            "flightrec_segment_seconds": flightrec_segment_seconds,
+            "flightrec_sample_interval": flightrec_sample_interval,
+            "flightrec_segments": flightrec_segments,
+            "flightrec_spike_504": flightrec_spike_504,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

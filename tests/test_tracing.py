@@ -48,9 +48,15 @@ def test_inject_extract_roundtrip():
     ctx = SpanContext(42, 99)
     headers: dict = {}
     t.inject_headers(ctx, headers)
-    assert headers == {TRACE_HEADER: "42", SPAN_HEADER: "99"}
+    # native headers plus the W3C traceparent twin
+    assert headers[TRACE_HEADER] == "42"
+    assert headers[SPAN_HEADER] == "99"
+    assert headers[tracing.TRACEPARENT_HEADER] == (
+        "00-" + "0" * 30 + "2a-" + "0" * 14 + "63-01"
+    )
     got = t.extract_headers(headers)
     assert (got.trace_id, got.span_id) == (42, 99)
+    assert got.remote is True
     assert t.extract_headers({}) is None
     assert t.extract_headers({TRACE_HEADER: "x", SPAN_HEADER: "1"}) is None
 

@@ -15,7 +15,12 @@ asserts the three operator-visible planes work over actual HTTP:
   ``/debug/vars``, ``batcher.queueWait`` attribution in the profile);
 * a concurrent int-field burst coalesces into query-batched BSI
   flights (batcher ``coalesced`` advances; the batched range-count
-  kernel shows up in the dispatch telemetry).
+  kernel shows up in the dispatch telemetry);
+* the incident plane: an SLO-slow query and a deadline-504 query are
+  tail-kept in ``/debug/traces`` (with span detail), ``/metrics``
+  histograms cite a kept trace as an OpenMetrics exemplar, and a
+  504-driven SLO burn makes the flight recorder capture exactly one
+  incident bundle at ``/debug/incidents``.
 
 Exit status 0 on success; any assertion/exception fails the CI step.
 Run as ``python -m tools.smoke_observability``.
@@ -24,7 +29,10 @@ Run as ``python -m tools.smoke_observability``.
 from __future__ import annotations
 
 import json
+import re
 import sys
+import time
+import urllib.error
 import urllib.request
 
 
@@ -42,7 +50,22 @@ def _post(uri: str, body: bytes, ctype: str = "text/plain") -> bytes:
 def main() -> int:
     from pilosa_tpu.server.node import NodeServer
 
-    node = NodeServer(port=0, slow_query_time=0.001)
+    node = NodeServer(
+        port=0,
+        slow_query_time=0.001,
+        # incident-plane knobs: a 1 us read.count p99 objective makes
+        # every count tail-kept as "slow"; fast burn windows + short
+        # recorder segments keep the smoke quick
+        slo_objectives={
+            "read.count": {"availability": 0.999, "latencyP99Ms": 0.001}
+        },
+        slo_burn_rules=[
+            {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4}
+        ],
+        slo_slot_seconds=1.0,
+        flightrec_segment_seconds=0.1,
+        trace_baseline_n=0,
+    )
     node.start()
     try:
         base = node.uri
@@ -180,6 +203,65 @@ def main() -> int:
         assert vars_["batcher"]["coalesced"] > coalesced0, vars_["batcher"]
         metrics = _get(f"{base}/metrics").decode()
         assert "bsi_range_count_batch" in metrics, metrics[:400]
+
+        # -- incident plane: tail-kept traces, exemplars, flight recorder
+        # every Count above outran the 1 us objective: kept as "slow"
+        traces = json.loads(_get(f"{base}/debug/traces"))
+        assert traces["store"]["stats"]["kept_slow"] >= 1, traces["store"]
+        slow_trace = next(
+            t for t in traces["traces"] if t["reason"] == "slow"
+        )
+        detail = json.loads(
+            _get(f"{base}/debug/traces?id={slow_trace['traceId']}")
+        )
+        assert any(s["name"] == "http.query" for s in detail["spans"]), detail
+        # erroring query: an impossible deadline 504s (server-attributed)
+        assert json.loads(_get(f"{base}/debug/incidents"))["incidents"] == []
+        for _ in range(3):
+            try:
+                _post(
+                    f"{base}/index/smoke/query?timeout=0.000001",
+                    b"Count(Row(f=1))",
+                )
+                raise AssertionError("tiny deadline did not 504")
+            except urllib.error.HTTPError as e:
+                assert e.code == 504, e.code
+        reasons = {
+            t["reason"]
+            for t in json.loads(_get(f"{base}/debug/traces"))["traces"]
+        }
+        assert "error" in reasons, reasons
+        # exemplar: the SLO latency histogram cites a kept trace id
+        metrics = _get(f"{base}/metrics").decode()
+        m = re.search(
+            r'pilosa_slo_request_duration_seconds_bucket\{[^}]*\}'
+            r' \d+ # \{trace_id="([0-9a-f]{32})"\}',
+            metrics,
+        )
+        assert m, "no exemplar in /metrics"
+        cited = json.loads(_get(f"{base}/debug/traces?id={m.group(1)}"))
+        assert cited["traceId"] == m.group(1), cited
+        # the 504 burn fires the burn-rate alert; the flight recorder
+        # captures exactly one incident bundle for the episode
+        deadline = time.monotonic() + 10.0
+        incidents = []
+        while time.monotonic() < deadline and not incidents:
+            incidents = json.loads(_get(f"{base}/debug/incidents"))[
+                "incidents"
+            ]
+            time.sleep(0.1)
+        assert len(incidents) == 1, incidents
+        assert incidents[0]["trigger"]["type"] == "slo-alert", incidents
+        bundle = json.loads(
+            _get(f"{base}/debug/incidents?id={incidents[0]['id']}")
+        )
+        assert bundle["segments"], bundle.keys()
+        assert "traces" in bundle and "slowQueries" in bundle, bundle.keys()
+        types = [
+            e["type"]
+            for e in json.loads(_get(f"{base}/debug/events"))["events"]
+        ]
+        assert "incident" in types, types
     finally:
         node.stop()
     print("observability smoke OK")
