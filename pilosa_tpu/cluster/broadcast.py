@@ -26,6 +26,9 @@ MSG_NODE_STATE = "node-state"
 MSG_NODE_EVENT = "node-event"
 MSG_RESIZE_INSTRUCTION = "resize-instruction"
 MSG_RESIZE_COMPLETE = "resize-instruction-complete"
+MSG_RESIZE_PREPARE = "resize-prepare"    # pending membership announced
+MSG_EPOCH_FLIP = "epoch-flip"            # per-shard ownership flip
+MSG_RESIZE_CANCEL = "resize-cancel"      # pending membership dropped
 MSG_SET_COORDINATOR = "set-coordinator"
 MSG_UPDATE_COORDINATOR = "update-coordinator"
 MSG_SCHEMA = "schema"

@@ -584,6 +584,54 @@ class InternalClient:
         (reference followResizeInstruction cluster.go:1272)."""
         self._json("POST", uri, "/internal/resize/fetch", req)
 
+    # -- online migration (snapshot stream + op-log catch-up) ---------------
+
+    def migrate_begin(
+        self, uri: str, index: str, field: str, view: str, shard: int,
+        chunk_bytes: int | None = None,
+    ) -> dict:
+        """Open a migration session on the source: pins a snapshot cut
+        and installs the delta tap.  Returns ``{token, size, opN}``."""
+        req: dict = {
+            "index": index, "field": field, "view": view, "shard": shard,
+        }
+        if chunk_bytes:
+            req["chunkBytes"] = int(chunk_bytes)
+        return self._json("POST", uri, "/internal/migrate/begin", req)
+
+    def migrate_chunk(self, uri: str, token: str, offset: int) -> bytes:
+        """One snapshot chunk at ``offset``.  GET + offset-addressed =
+        idempotent, so a crashed/retried target resumes mid-stream."""
+        return self._do(
+            "GET", uri,
+            f"/internal/migrate/chunk?token={token}&offset={int(offset)}",
+        )
+
+    def migrate_delta(self, uri: str, token: str) -> tuple[dict, bytes]:
+        """Drain one op-log catch-up round; returns the frame header
+        (``ops``, ``pending``) and the raw op-record blob."""
+        from pilosa_tpu.cluster import wire
+
+        body = self._do(
+            "POST", uri, "/internal/migrate/delta",
+            json.dumps({"token": token}).encode(),
+        )
+        return wire.decode_migrate_frame(body)
+
+    def migrate_end(self, uri: str, token: str) -> None:
+        """Close a migration session (uninstalls the tap)."""
+        self._json("POST", uri, "/internal/migrate/end", {"token": token})
+
+    def migrate_fetch(self, uri: str, req: dict) -> dict:
+        """Tell a target to pull the listed fragments (snapshot stream +
+        catch-up) and HOLD the sessions open for the finalize drain."""
+        return self._json("POST", uri, "/internal/migrate/fetch", req)
+
+    def migrate_finalize(self, uri: str, req: dict) -> dict:
+        """Tell a target to drain final deltas + close its held sessions
+        (called after the ownership flip broadcast)."""
+        return self._json("POST", uri, "/internal/migrate/finalize", req)
+
     # -- control plane ------------------------------------------------------
 
     def send_message(self, uri: str, msg: dict) -> None:
@@ -697,6 +745,24 @@ class NopInternalClient:
 
     def resize_fetch(self, uri, req):
         pass
+
+    def migrate_begin(self, uri, index, field, view, shard, chunk_bytes=None):
+        return {"token": "", "size": 0, "opN": 0}
+
+    def migrate_chunk(self, uri, token, offset):
+        return b""
+
+    def migrate_delta(self, uri, token):
+        return {"ops": 0, "pending": 0}, b""
+
+    def migrate_end(self, uri, token):
+        pass
+
+    def migrate_fetch(self, uri, req):
+        return {}
+
+    def migrate_finalize(self, uri, req):
+        return {}
 
     def send_message(self, uri, msg):
         pass

@@ -54,6 +54,15 @@ class Cluster:
             Node(id=node_id, uri=uri, is_coordinator=(self.coordinator_id == node_id))
         ]
         self.on_state_change = None  # hook: fn(new_state)
+        # In-flight online resize: while a migration runs the cluster
+        # keeps serving from ``nodes``, but shards whose transfer has
+        # completed flip — one (index, shard) at a time — onto the
+        # ``pending_nodes`` placement.  ``epoch`` is a monotonic fence:
+        # every flip/commit/abort bumps it, so a node can reject stale
+        # flip broadcasts from an aborted resize generation.
+        self.pending_nodes: list[Node] | None = None
+        self.flipped: set[tuple[str, int]] = set()
+        self.epoch = 0
 
     # -- membership ---------------------------------------------------------
 
@@ -91,17 +100,74 @@ class Cluster:
             return True
 
     def set_static(self, nodes: list[Node]) -> None:
-        """Fix membership at boot (reference setStatic cluster.go:2000)."""
+        """Fix membership at boot (reference setStatic cluster.go:2000).
+        Also the resize-commit landing point: committing a membership
+        resolves any in-flight per-shard flip state."""
         with self._lock:
             self.nodes = sorted(nodes, key=lambda n: n.id)
             for n in self.nodes:
                 n.is_coordinator = n.id == self.coordinator_id
+            if self.pending_nodes is not None:
+                self.pending_nodes = None
+                self.flipped = set()
+                self.epoch += 1
             changed = self.state != STATE_NORMAL
             self.state = STATE_NORMAL
         # The implicit RESIZING->NORMAL edge of a membership commit must
         # reach the observer hook like any explicit set_state call.
         if changed and self.on_state_change is not None:
             self.on_state_change(STATE_NORMAL)
+
+    # -- online resize (per-shard flips instead of a cluster-wide gate) -----
+
+    def begin_resize(self, pending_nodes: list[Node], epoch: int | None = None) -> int:
+        """Arm an in-flight resize: placement stays on the current
+        membership until individual shards flip.  Returns the new epoch
+        (the coordinator broadcasts it; followers pass it back in so
+        every node agrees on the fence value)."""
+        with self._lock:
+            # A re-prepare on the SAME epoch is a coordinator resuming an
+            # interrupted resize: shards it already flipped must stay
+            # flipped, or routing would snap back to the old ring while
+            # the targets already drained their sessions.
+            same = (
+                self.pending_nodes is not None
+                and epoch is not None
+                and epoch == self.epoch
+            )
+            self.pending_nodes = sorted(pending_nodes, key=lambda n: n.id)
+            if not same:
+                self.flipped = set()
+            self.epoch = epoch if epoch is not None else self.epoch + 1
+            return self.epoch
+
+    def flip_shard(self, index: str, shard: int, epoch: int | None = None) -> bool:
+        """Move one shard's placement onto the pending membership.
+        Rejected (returns False) when no resize is armed or the flip
+        rides a stale epoch — a crashed-and-aborted resize generation
+        must not flip shards of a later one."""
+        with self._lock:
+            if self.pending_nodes is None:
+                return False
+            if epoch is not None and epoch != self.epoch:
+                return False
+            self.flipped.add((index, int(shard)))
+            return True
+
+    def abort_resize(self) -> None:
+        """Drop the pending membership: every shard — flipped or not —
+        goes back to the current placement (the data still lives there;
+        targets only ever held copies until commit)."""
+        with self._lock:
+            if self.pending_nodes is None:
+                return
+            self.pending_nodes = None
+            self.flipped = set()
+            self.epoch += 1
+
+    @property
+    def resize_pending(self) -> bool:
+        return self.pending_nodes is not None
 
     # -- state machine ------------------------------------------------------
 
@@ -135,20 +201,32 @@ class Cluster:
     def partition(self, index: str, shard: int) -> int:
         return partition_hash(index, shard, self.partition_n)
 
+    def _ring_nodes(self, ring: list[Node], partition_id: int) -> list[Node]:
+        n = len(ring)
+        if n == 0:
+            return []
+        primary = jump_hash(partition_id, n)
+        count = min(self.replica_n, n)
+        return [ring[(primary + i) % n] for i in range(count)]
+
     def partition_nodes(self, partition_id: int) -> list[Node]:
         """Primary + replicas for a partition: jump-hash picks the primary
         ordinal; ReplicaN consecutive ring nodes follow (reference
         cluster.go:878-898)."""
         with self._lock:
-            n = len(self.nodes)
-            if n == 0:
-                return []
-            primary = jump_hash(partition_id, n)
-            count = min(self.replica_n, n)
-            return [self.nodes[(primary + i) % n] for i in range(count)]
+            return self._ring_nodes(self.nodes, partition_id)
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
-        return self.partition_nodes(self.partition(index, shard))
+        """Owner set for one shard — the single point every read/write
+        route derives from.  During an online resize a flipped shard
+        resolves over the pending membership, so routing follows each
+        per-shard ownership flip the moment it lands, with no
+        cluster-wide gate."""
+        with self._lock:
+            ring = self.nodes
+            if self.pending_nodes is not None and (index, int(shard)) in self.flipped:
+                ring = self.pending_nodes
+            return self._ring_nodes(ring, self.partition(index, shard))
 
     def primary_shard_node(self, index: str, shard: int) -> Node:
         return self.shard_nodes(index, shard)[0]
@@ -189,4 +267,7 @@ class Cluster:
                 "partitionN": self.partition_n,
                 "coordinator": self.coordinator_id,
                 "nodes": [n.to_dict() for n in self.nodes],
+                "epoch": self.epoch,
+                "resizePending": self.pending_nodes is not None,
+                "flippedShards": len(self.flipped),
             }

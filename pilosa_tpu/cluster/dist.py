@@ -246,13 +246,26 @@ class DistributedExecutor:
         return {
             self._submit(
                 self.client.query_node,
-                self.cluster.node(node_id).uri,
+                self._node_by_id(node_id).uri,
                 index_name,
                 str(call),
                 nshards if nshards is not None else [],
             ): node_id
             for node_id, nshards in by_node.items()
         }
+
+    def _node_by_id(self, node_id: str):
+        """Resolve a node for fan-out, including JOINING nodes: during an
+        online resize a flipped shard routes to a pending-ring member
+        that is not in ``cluster.nodes`` until the commit lands."""
+        n = self.cluster.node(node_id)
+        if n is None and self.cluster.pending_nodes is not None:
+            for p in self.cluster.pending_nodes:
+                if p.id == node_id:
+                    return p
+        if n is None:
+            raise NoAvailableReplicaError(f"unknown fan-out node {node_id}")
+        return n
 
     @staticmethod
     def _collect_writes(futures: dict) -> list[Any]:
@@ -335,7 +348,20 @@ class DistributedExecutor:
                 # is spent — re-mapping shards onto replicas is pointless
                 # work the caller will never see.
                 deadline.check(f"mapping {call.name} over {index_name}")
-                groups = self._group_by_live_owner(index_name, pending, bad_nodes)
+                try:
+                    groups = self._group_by_live_owner(
+                        index_name, pending, bad_nodes
+                    )
+                except NoAvailableReplicaError:
+                    if not self.cluster.resize_pending:
+                        raise
+                    # Mid-resize a shard can flip between grouping and
+                    # failover: the node that just failed may no longer
+                    # be in the (post-flip) owner set at all.  Re-group
+                    # once against the current ring with a clean slate.
+                    groups = self._group_by_live_owner(
+                        index_name, pending, set()
+                    )
                 pending = []
                 # Remote nodes are queried CONCURRENTLY (one pool task per
                 # node, the reference's goroutine-per-node mapper,
@@ -347,7 +373,7 @@ class DistributedExecutor:
                 futures = {
                     self._submit(
                         self._query_remote,
-                        self.cluster.node(node_id).uri,
+                        self._node_by_id(node_id).uri,
                         node_id,
                         index_name,
                         pql_text,

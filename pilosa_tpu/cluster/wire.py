@@ -189,6 +189,38 @@ def encode_import(req: dict, width: int | None = None) -> bytes | None:
     )
 
 
+# ---------------------------------------------------------------------------
+# Migration frames (online resize: snapshot chunks + op-log deltas)
+# ---------------------------------------------------------------------------
+#
+# Same shape as the import payload: magic + 4-byte LE header length +
+# JSON header + raw blob.  The blob is either a slice of a serialized
+# roaring snapshot (chunk) or concatenated op-log records (delta) —
+# both already self-framing, so the header only carries bookkeeping
+# (offset / op counts) the receiver needs without parsing the blob.
+
+MIGRATE_MAGIC = b"PTM1"
+
+
+def encode_migrate_frame(header: dict, blob: bytes = b"") -> bytes:
+    import json as _json
+
+    hjson = _json.dumps(header).encode()
+    return b"".join(
+        [MIGRATE_MAGIC, len(hjson).to_bytes(4, "little"), hjson, blob]
+    )
+
+
+def decode_migrate_frame(body: bytes) -> tuple[dict, bytes]:
+    import json as _json
+
+    if body[:4] != MIGRATE_MAGIC:
+        raise ValueError("bad migrate frame magic")
+    hlen = int.from_bytes(body[4:8], "little")
+    header = _json.loads(body[8 : 8 + hlen].decode())
+    return header, body[8 + hlen :]
+
+
 def decode_import(body: bytes) -> dict:
     """Binary import body -> the same request dict shape the JSON path
     produces (numpy arrays instead of lists; always marked remote)."""

@@ -203,17 +203,33 @@ class LoadHarness:
     """Runs staged open-loop load against cluster node URIs and builds
     the SLO report dict (see loadgen/report.py for the schema)."""
 
+    # A stage dips below this ok-ratio -> its availability verdict fails
+    # (the resize stage's contract: no cluster-wide error window).
+    AVAILABILITY_FLOOR = 0.99
+
     def __init__(
         self,
         uris: list[str],
         config: WorkloadConfig,
         stages: list[StageSpec],
+        stage_hooks: dict | None = None,
+        availability_floor: float | None = None,
     ):
         if not uris:
             raise ValueError("at least one node URI required")
         self.uris = list(uris)
         self.config = config
         self.stages = list(stages)
+        # name -> zero-arg callable run CONCURRENTLY with that stage's
+        # traffic (the resize stage's add/remove-node driver); the stage
+        # doesn't end until the hook returns, and a hook exception lands
+        # in the stage's report entry instead of killing the run.
+        self.stage_hooks = dict(stage_hooks or {})
+        self.availability_floor = (
+            self.AVAILABILITY_FLOOR
+            if availability_floor is None
+            else float(availability_floor)
+        )
 
     def generate(self) -> list[list]:
         """Pre-generate every stage's op sequence (the full request
@@ -246,6 +262,22 @@ class LoadHarness:
             ]
             for t in threads:
                 t.start()
+            hook_thread = None
+            hook_errors: list[str] = []
+            hook = self.stage_hooks.get(stage.name)
+            if hook is not None:
+                def _run_hook(fn=hook, errs=hook_errors):
+                    try:
+                        fn()
+                    except Exception as e:  # graftlint: disable=exception-hygiene -- surfaced in the stage's report entry; the load run must finish either way
+                        logger.exception("stage hook failed")
+                        errs.append(f"{type(e).__name__}: {e}")
+
+                hook_thread = threading.Thread(
+                    target=_run_hook, name=f"loadgen-hook-{stage.name}",
+                    daemon=True,
+                )
+                hook_thread.start()
             t0 = time.monotonic()
             interval = 1.0 / stage.rate if stage.rate > 0 else 0.0
             for k, op in enumerate(ops):
@@ -257,10 +289,29 @@ class LoadHarness:
                 live_snapshot = _fetch_json(self.uris[0], "/debug/slo")
             for t in threads:
                 t.join()
+            if hook_thread is not None:
+                hook_thread.join()
             stop.set()
             results.extend(outs)
+            # Per-stage availability verdict: the share of this stage's
+            # ops answered 2xx/3xx.  The resize stage's acceptance rides
+            # on this — membership changes must not open an error window.
+            ok_ops = sum(
+                1 for o in outs for r in o.records if r[3]
+            )
+            stage_client_errors = sum(o.client_errors for o in outs)
+            availability = ok_ops / len(ops) if ops else 1.0
             stage_meta.append(
-                {**stage.to_dict(), "ops": len(ops)}
+                {
+                    **stage.to_dict(),
+                    "ops": len(ops),
+                    "okOps": ok_ops,
+                    "clientErrors": stage_client_errors,
+                    "availability": availability,
+                    "availabilityOk": availability >= self.availability_floor,
+                    "hookRan": hook is not None,
+                    "hookError": hook_errors[0] if hook_errors else None,
+                }
             )
         wall = time.monotonic() - t_run0
         records = [r for out in results for r in out.records]
@@ -268,6 +319,7 @@ class LoadHarness:
         server_slo = _fetch_json(self.uris[0], "/debug/slo")
         metrics_text = _fetch_text(self.uris[0], "/metrics")
         incidents = _fetch_json(self.uris[0], "/debug/incidents")
+        events = _fetch_json(self.uris[0], "/debug/events")
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -279,6 +331,7 @@ class LoadHarness:
             live_slo_ok=bool(live_snapshot and live_snapshot.get("classes") is not None),
             slo_metrics_present="pilosa_slo_requests_total" in metrics_text,
             incidents=incidents,
+            events=events,
         )
 
 
@@ -289,11 +342,14 @@ def run_harness(
     cluster_kwargs: dict | None = None,
     faults: list[dict] | None = None,
     preload_bits: int = 4096,
+    stage_hooks: dict | None = None,
 ) -> dict:
     """Boot an InProcessCluster, prepare schema + seed data, drive the
     staged workload, and return the report dict.  ``cluster_kwargs``
     passes through to InProcessCluster (SLO window knobs etc.);
-    ``faults`` is a list of ``inject_fault`` kwargs dicts."""
+    ``faults`` is a list of ``inject_fault`` kwargs dicts.
+    ``stage_hooks`` maps stage name -> callable(cluster) run concurrently
+    with that stage's traffic (e.g. add/remove a node mid-zipfian)."""
     from pilosa_tpu.testing.cluster import InProcessCluster
 
     kwargs = dict(cluster_kwargs or {})
@@ -303,7 +359,12 @@ def run_harness(
             preload(cluster, config, preload_bits)
         for f in faults or []:
             cluster.inject_fault(**f)
+        bound_hooks = {
+            name: (lambda fn=fn: fn(cluster))
+            for name, fn in (stage_hooks or {}).items()
+        }
         harness = LoadHarness(
-            [n.uri for n in cluster.nodes], config, stages
+            [n.uri for n in cluster.nodes], config, stages,
+            stage_hooks=bound_hooks,
         )
         return harness.run()

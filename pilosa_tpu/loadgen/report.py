@@ -34,6 +34,7 @@ def build_report(
     live_slo_ok: bool,
     slo_metrics_present: bool,
     incidents: dict | None = None,
+    events: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -92,6 +93,11 @@ def build_report(
         # flight-recorder view after the run: incident bundles captured
         # by burning alerts / 504 spikes during the fault stages
         "incidents": (incidents or {}).get("incidents", []),
+        # coordinator event journal after the run: the resize stage's
+        # timeline (resize-start .. epoch-flip .. resize-commit) rides
+        # here so SLO_r*.json is self-contained evidence of an online
+        # membership change under load
+        "events": (events or {}).get("events", []),
         "verdicts": verdicts,
         "pass": overall,
     }

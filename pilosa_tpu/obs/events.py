@@ -39,6 +39,11 @@ EVENT_RESIZE_START = "resize-start"
 EVENT_RESIZE_PHASE = "resize-phase"
 EVENT_RESIZE_COMMIT = "resize-commit"
 EVENT_RESIZE_ABORT = "resize-abort"
+EVENT_RESIZE_RESUME = "resize-resume"      # journaled plan re-dispatched
+EVENT_RESIZE_DATA_LOSS = "resize-data-loss"  # dead removal dropped fragments
+EVENT_RESIZE_WATCHDOG = "resize-watchdog"  # node self-healed a missed commit
+EVENT_MIGRATE_FRAGMENT = "migrate-fragment"  # one fragment's migration done
+EVENT_EPOCH_FLIP = "epoch-flip"            # per-shard ownership flipped
 EVENT_ANTIENTROPY_ROUND = "antientropy-round"
 EVENT_CIRCUIT_BREAKER = "circuit-breaker"
 EVENT_SNAPSHOT = "snapshot"              # fragment op-log compaction

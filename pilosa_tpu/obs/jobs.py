@@ -57,6 +57,15 @@ class Job:
                 self.phase = phase
                 self.updated = time.time()
 
+    def annotate(self, **meta) -> None:
+        """Merge keys into the job's meta mid-flight (e.g. op-log
+        catch-up lag per migration round) — meta is for labels that
+        aren't monotonic counters, which is what progress is for."""
+        with self._lock:
+            if self.status == STATUS_RUNNING:
+                self.meta.update(meta)
+                self.updated = time.time()
+
     def advance(self, **counters: float) -> None:
         """Increment progress counters, e.g. ``advance(fragments_done=1,
         bytes=4096)``.  Counters never go backwards."""

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import io
 import logging
+import random
 import threading
 import time
 from typing import Any
@@ -17,8 +18,10 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu import __version__, deadline
+from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import qprofile, slo
+from pilosa_tpu.testing import faults
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core import timequantum
@@ -148,6 +151,19 @@ class API:
                 window=batch_window,
                 max_batch=batch_max_size,
             )
+        # Online-migration state (cluster/migration.py): source-side
+        # session registry (snapshot cut + delta tap per in-flight
+        # fragment transfer) and the target-side held pulls awaiting the
+        # post-flip finalize drain.
+        from pilosa_tpu.cluster.migration import MigrationRegistry
+
+        self.migrations = MigrationRegistry(self._node_id())
+        self._migrate_pulls: dict[tuple, dict] = {}
+        self._migrate_lock = threading.Lock()
+        # Coordinator-side resume state: in-process mirror of the
+        # on-disk resize journal, so storeless clusters can resume an
+        # interrupted resize too (cluster/resize.py).
+        self._resize_journal: dict | None = None
 
     @property
     def state(self) -> str:
@@ -733,13 +749,20 @@ class API:
         nodes = self._nodes_info()
         # schema rides along for peer status exchange (the reference's
         # NodeStatus carries schema on gossip push/pull, gossip.go:321-357).
-        return {
+        out = {
             "state": self.state,
             "nodes": nodes,
             "localID": self._node_id(),
             "schema": self.holder.schema(),
             "availableShards": self.available_shards_map(),
         }
+        if self.cluster is not None:
+            # Resize visibility: followers' watchdogs poll this to tell a
+            # coordinator still migrating from one that died mid-resize.
+            out["coordinator"] = self.cluster.coordinator_id
+            out["epoch"] = self.cluster.epoch
+            out["resizePending"] = self.cluster.resize_pending
+        return out
 
     def info(self) -> dict:
         self._validate("Info")
@@ -1142,6 +1165,285 @@ class API:
         job.finish("done")
         return {"fetched": fetched}
 
+    # -- online migration (snapshot stream + op-log catch-up) ---------------
+    #
+    # Per-fragment migration for the online resize (cluster/resize.py):
+    # the target pulls a pinned snapshot cut in resumable chunks
+    # (ChunkPrefetcher overlaps fetch with apply, the PR-7 uploader
+    # pattern pointed the other way), then replays op-log deltas in
+    # bounded catch-up rounds while writes keep landing on the source.
+    # Sessions stay open on the source until the post-flip finalize
+    # drain.  ``faults.stage_fault`` hooks mark every phase boundary so
+    # chaos tests can kill any participant at any point.
+
+    _CATCHUP_ROUNDS = 5
+    _SOURCE_ATTEMPTS = 3
+
+    def _migration(self, token: str):
+        try:
+            return self.migrations.get(token)
+        except KeyError as e:
+            raise NotFoundError(str(e))
+
+    def migrate_begin(self, req: dict) -> dict:
+        """Source side: open a migration session — pin a snapshot cut
+        and install the op-log delta tap (cluster/migration.py)."""
+        self._validate("FragmentData")
+        faults.stage_fault("source:begin")
+        index, field = req["index"], req["field"]
+        view = req.get("view", VIEW_STANDARD)
+        shard = int(req["shard"])
+        frag = self._fragment(index, field, view, shard)
+        session = self.migrations.begin(frag, (index, field, view, shard))
+        session.chunk_bytes = int(req.get("chunkBytes") or 0) or None
+        return {
+            "token": session.token,
+            "size": session.size,
+            "opN": int(getattr(frag, "op_n", 0)),
+        }
+
+    def migrate_chunk(self, token: str, offset: int) -> bytes:
+        """Source side: one snapshot chunk.  Offset-addressed reads are
+        idempotent, so a retried/restarted target resumes mid-stream."""
+        self._validate("FragmentData")
+        faults.stage_fault("source:chunk")
+        session = self._migration(token)
+        from pilosa_tpu.cluster import migration
+
+        return session.chunk(
+            int(offset), session.chunk_bytes or migration.CHUNK_BYTES
+        )
+
+    def migrate_delta(self, token: str) -> bytes:
+        """Source side: drain one op-log catch-up round as a binary
+        migrate frame (header carries ops-in-blob + ops still pending)."""
+        self._validate("FragmentData")
+        faults.stage_fault("source:delta")
+        session = self._migration(token)
+        blob, count, pending = session.delta()
+        from pilosa_tpu.cluster import wire
+
+        return wire.encode_migrate_frame(
+            {"ops": count, "pending": pending}, blob
+        )
+
+    def migrate_end(self, token: str) -> dict:
+        """Source side: close a session (uninstalls the delta tap)."""
+        self._validate("FragmentData")
+        self.migrations.end(token)
+        return {}
+
+    def migrate_fetch(self, req: dict) -> dict:
+        """Target side: pull every listed fragment (snapshot stream +
+        catch-up rounds) and HOLD the source sessions open; the
+        coordinator flips ownership, then ``migrate_finalize`` drains
+        the tail.  A crash here aborts only this target's instructions —
+        its held source sessions expire via the registry TTL."""
+        self._validate("FragmentData")
+        if self.client is None:
+            raise ApiError("no internal client configured", 500)
+        if req.get("schema"):
+            # Joining node: install schema before any fragment lands
+            # (reference cluster.go:1304-1323).
+            self.holder.apply_schema(req["schema"])
+            self._sync()
+        instructions = req.get("instructions", [])
+        job = self.holder.jobs.start(
+            "migrate-fetch", fragments=len(instructions)
+        )
+        job.set_phase("snapshot")
+        job.set_progress(fragments_total=len(instructions))
+        pulls = []
+        try:
+            for ins in instructions:
+                pulls.append(self._migrate_pull(ins, job))
+                job.advance(fragments_done=1)
+        except Exception as e:
+            for p in pulls:
+                try:
+                    self.client.migrate_end(p["uri"], p["token"])
+                except Exception:  # graftlint: disable=exception-hygiene -- best-effort cleanup of held source sessions; the TTL sweep covers the rest
+                    pass
+            job.finish("aborted", error=f"{type(e).__name__}: {e}")
+            raise
+        with self._migrate_lock:
+            for p in pulls:
+                self._migrate_pulls[p["key"]] = p
+        job.finish("done")
+        return {"fetched": len(pulls)}
+
+    def _migrate_pull(self, ins: dict, job) -> dict:
+        """Pull one fragment, trying each listed source holder in turn
+        (a dead source retries with seeded backoff, then the next
+        replica takes over)."""
+        import zlib as _zlib
+
+        from pilosa_tpu.cluster.migration import CHUNK_BYTES
+
+        index, fname = ins["index"], ins["field"]
+        view = ins.get("view", VIEW_STANDARD)
+        shard = int(ins["shard"])
+        f = self.holder.field(index, fname)
+        if f is None:
+            raise ApiError(
+                f"migrate target missing schema for {index}/{fname}", 500
+            )
+        sources = list(ins.get("sourceURIs") or [])
+        if ins.get("sourceURI") and ins["sourceURI"] not in sources:
+            sources.append(ins["sourceURI"])
+        if not sources:
+            raise ApiError(f"no source for {index}/{fname}/{shard}", 500)
+        chunk_bytes = int(ins.get("chunkBytes") or CHUNK_BYTES)
+        # Seeded by the fragment key: a chaos run's retry cadence
+        # replays identically (testing/faults.py contract).
+        rng = random.Random(
+            _zlib.crc32(f"{index}/{fname}/{view}/{shard}".encode())
+        )
+        last_err: Exception | None = None
+        for uri in sources:
+            for attempt in range(self._SOURCE_ATTEMPTS):
+                try:
+                    return self._migrate_pull_from(
+                        uri, index, f, view, shard, chunk_bytes, job
+                    )
+                except (ClientError, OSError) as e:
+                    last_err = e
+                    if attempt < self._SOURCE_ATTEMPTS - 1:
+                        time.sleep(
+                            0.05 * (2 ** attempt) * (0.5 + rng.random())
+                        )
+            logger.warning(
+                "migrate pull of %s/%s/%s/%s from %s failed: %s",
+                index, fname, view, shard, uri, last_err,
+            )
+        raise ApiError(
+            f"migrate pull failed from every source for "
+            f"{index}/{fname}/{view}/{shard}: {last_err}", 500
+        )
+
+    def _migrate_pull_from(
+        self, uri: str, index: str, f, view: str, shard: int,
+        chunk_bytes: int, job,
+    ) -> dict:
+        from pilosa_tpu.ingest.pipeline import ChunkPrefetcher
+
+        begin = self.client.migrate_begin(
+            uri, index, f.name, view, shard, chunk_bytes=chunk_bytes
+        )
+        token, size = begin["token"], int(begin["size"])
+        try:
+            buf = bytearray()
+            pf = ChunkPrefetcher(
+                lambda off: self.client.migrate_chunk(uri, token, off),
+                size=size, chunk_bytes=chunk_bytes,
+            )
+            try:
+                for _off, blob in pf:
+                    buf += blob
+                    job.advance(bytes_moved=len(blob))
+            finally:
+                pf.close()
+            faults.stage_fault("target:apply")
+            if buf:
+                self._apply_roaring(index, f, shard, bytes(buf), False, view)
+            # Bounded catch-up: writes kept landing on the source during
+            # the snapshot stream; replay the accrued op-log delta until
+            # lag reaches zero (or rounds exhaust — the post-flip
+            # finalize drain is the backstop either way).
+            job.set_phase("catch-up")
+            lag = 0
+            for _round in range(self._CATCHUP_ROUNDS):
+                faults.stage_fault("target:catchup")
+                header, blob = self.client.migrate_delta(uri, token)
+                if blob:
+                    self._apply_delta_ops(index, f, shard, view, blob)
+                lag = int(header.get("pending", 0))
+                job.annotate(
+                    catchup_lag=lag, catchup_ops=int(header.get("ops", 0))
+                )
+                if lag == 0:
+                    break
+            return {
+                "key": (index, f.name, view, shard),
+                "uri": uri,
+                "token": token,
+                "lag": lag,
+            }
+        except Exception:
+            try:
+                self.client.migrate_end(uri, token)
+            except Exception:  # graftlint: disable=exception-hygiene -- cleanup of a failed pull; the session TTL covers an unreachable source
+                pass
+            raise
+
+    def _apply_delta_ops(
+        self, index: str, f, shard: int, view: str, blob: bytes
+    ) -> int:
+        """Replay raw op-log records IN ORDER onto the local fragment —
+        the catch-up half of migration.  In-order replay makes overlap
+        with the snapshot cut harmless: the same ops apply in the same
+        order the source applied them, and set/clear are idempotent."""
+        applied = 0
+        for op_type, payload, _opn in roaring.decode_ops(blob, 0):
+            if op_type in (roaring.OP_ADD, roaring.OP_REMOVE):
+                positions = np.array([payload], dtype=np.uint64)
+            elif op_type in (roaring.OP_ADD_BATCH, roaring.OP_REMOVE_BATCH):
+                positions = np.asarray(payload, dtype=np.uint64)
+            else:
+                positions = roaring.deserialize(payload)
+            if not len(positions):
+                continue
+            clear = op_type in (
+                roaring.OP_REMOVE, roaring.OP_REMOVE_BATCH,
+                roaring.OP_REMOVE_ROARING,
+            )
+            self._apply_roaring_positions(
+                index, f, shard, positions, clear, view
+            )
+            applied += 1
+        return applied
+
+    def migrate_finalize(self, req: dict) -> dict:
+        """Target side, post-flip: drain the final op-log delta from
+        each held source session and close it.  An unreachable source
+        is non-fatal — anti-entropy heals whatever tail it buffered."""
+        self._validate("FragmentData")
+        instructions = req.get("instructions")
+        with self._migrate_lock:
+            if instructions is None:
+                pulls = list(self._migrate_pulls.values())
+                self._migrate_pulls.clear()
+            else:
+                pulls = []
+                for ins in instructions:
+                    key = (
+                        ins["index"], ins["field"],
+                        ins.get("view", VIEW_STANDARD), int(ins["shard"]),
+                    )
+                    p = self._migrate_pulls.pop(key, None)
+                    if p is not None:
+                        pulls.append(p)
+        drained = 0
+        for p in pulls:
+            faults.stage_fault("target:finalize")
+            index, fname, view, shard = p["key"]
+            f = self.holder.field(index, fname)
+            try:
+                _header, blob = self.client.migrate_delta(
+                    p["uri"], p["token"]
+                )
+                if blob and f is not None:
+                    drained += self._apply_delta_ops(
+                        index, f, int(shard), view, blob
+                    )
+                self.client.migrate_end(p["uri"], p["token"])
+            except (ClientError, OSError) as e:
+                logger.warning(
+                    "finalize drain of %s from %s failed (anti-entropy"
+                    " heals the tail): %s", p["key"], p["uri"], e,
+                )
+        return {"finalized": len(pulls), "ops": drained}
+
     def _clean_unowned_fragments(self) -> int:
         """Drop fragments this node no longer owns after a membership
         change (reference holderCleaner holder.go:898-926)."""
@@ -1243,6 +1545,39 @@ class API:
                     # A removed node keeps its data (the reference expects
                     # it to shut down; its fragments were re-sourced).
                     self._clean_unowned_fragments()
+        elif t == bc.MSG_RESIZE_PREPARE:
+            # Per-fragment migration begins: remember the PENDING
+            # membership + epoch so flips can route flipped shards onto
+            # the new ring while everything else stays put.  The cluster
+            # state stays NORMAL — reads and writes keep flowing.
+            if self.cluster is not None and hasattr(self.cluster, "begin_resize"):
+                from pilosa_tpu.cluster.topology import Node as CNode
+
+                pending = [
+                    CNode(id=n["id"], uri=n.get("uri", ""))
+                    for n in msg.get("nodes", [])
+                ]
+                epoch = self.cluster.begin_resize(pending, msg.get("epoch"))
+                self.holder.events.record(
+                    ev.EVENT_RESIZE_PHASE, phase="prepare", epoch=epoch,
+                )
+        elif t == bc.MSG_EPOCH_FLIP:
+            # One shard's ownership flips to the pending ring.
+            if self.cluster is not None and hasattr(self.cluster, "flip_shard"):
+                if self.cluster.flip_shard(
+                    msg["index"], int(msg["shard"]), msg.get("epoch")
+                ):
+                    self.holder.events.record(
+                        ev.EVENT_EPOCH_FLIP,
+                        index=msg["index"], shard=int(msg["shard"]),
+                        epoch=msg.get("epoch"),
+                    )
+        elif t == bc.MSG_RESIZE_CANCEL:
+            if self.cluster is not None and hasattr(self.cluster, "abort_resize"):
+                self.cluster.abort_resize()
+                self.holder.events.record(
+                    ev.EVENT_RESIZE_ABORT, reason=msg.get("reason", ""),
+                )
         elif t == bc.MSG_NODE_STATE:
             if self.cluster is not None and hasattr(self.cluster, "mark_node_state"):
                 self.cluster.mark_node_state(msg["node"], msg["state"])
@@ -1320,6 +1655,9 @@ class API:
         rc = ResizeCoordinator(self.cluster, self.client, self)
         nodes = list(self.cluster.nodes)
         rc._commit_membership(nodes, nodes)
+        # The operator chose to abandon the interrupted plan: drop the
+        # journal so a later resume() can't replay a dead resize.
+        rc._delete_journal()
         return {"aborted": True}
 
     def resize_remove_node(self, node_id: str) -> dict:
@@ -1342,6 +1680,21 @@ class API:
         except ResizeError as e:
             raise ApiError(str(e), 400)
         return {"removed": node_id}
+
+    def resize_resume(self) -> dict:
+        """Resume an interrupted resize from the persisted journal (a
+        coordinator crash mid-migration leaves a resumable plan behind;
+        re-dispatch is idempotent).  Valid only on the coordinator."""
+        if self.cluster is None:
+            raise ApiError("cluster not configured", 400)
+        if not self.cluster.is_coordinator:
+            raise ApiError("resize-resume must run on the coordinator", 400)
+        from pilosa_tpu.cluster.resize import ResizeCoordinator, ResizeError
+
+        try:
+            return ResizeCoordinator(self.cluster, self.client, self).resume()
+        except ResizeError as e:
+            raise ApiError(str(e), 400)
 
     def set_coordinator(self, node_id: str) -> dict:
         """Move the coordinator (and with it the translation-primary
@@ -1382,6 +1735,7 @@ class API:
             self.store.sync()
 
     def close(self) -> None:
+        self.migrations.close()  # detach any live delta taps
         if self.flightrec is not None:
             self.flightrec.stop()
         if self.batcher is not None:

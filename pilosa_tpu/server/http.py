@@ -98,6 +98,13 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/data$"), "fragment_data"),
     ("GET", re.compile(r"^/internal/fragments$"), "fragments"),
     ("POST", re.compile(r"^/internal/resize/fetch$"), "resize_fetch"),
+    ("POST", re.compile(r"^/internal/migrate/begin$"), "migrate_begin"),
+    ("GET", re.compile(r"^/internal/migrate/chunk$"), "migrate_chunk"),
+    ("POST", re.compile(r"^/internal/migrate/delta$"), "migrate_delta"),
+    ("POST", re.compile(r"^/internal/migrate/end$"), "migrate_end"),
+    ("POST", re.compile(r"^/internal/migrate/fetch$"), "migrate_fetch"),
+    ("POST", re.compile(r"^/internal/migrate/finalize$"), "migrate_finalize"),
+    ("POST", re.compile(r"^/cluster/resize/resume$"), "resize_resume"),
     ("GET", re.compile(r"^/internal/nodes$"), "nodes"),
 ]
 
@@ -337,6 +344,11 @@ class Handler(BaseHTTPRequestHandler):
             # ingest-plane block: pool depth/inflight, staging occupancy,
             # upload overlap — the pipeline's live tuning signals
             snap["ingest"] = ingest.snapshot()
+        migrations = getattr(self.api, "migrations", None)
+        if migrations is not None:
+            # source-side migration sessions: per-fragment pending
+            # delta ops = live catch-up lag during an online resize
+            snap["migrations"] = migrations.snapshot_summary()
         self._send_json(200, snap)
 
     def r_debug_slo(self):
@@ -595,6 +607,32 @@ class Handler(BaseHTTPRequestHandler):
 
     def r_resize_fetch(self):
         self._send_json(200, self.api.resize_fetch(self._json_body()))
+
+    def r_migrate_begin(self):
+        self._send_json(200, self.api.migrate_begin(self._json_body()))
+
+    def r_migrate_chunk(self):
+        p = {k: v[0] for k, v in self.query_params.items()}
+        data = self.api.migrate_chunk(p["token"], int(p.get("offset", 0)))
+        self._send(200, data, content_type="application/octet-stream")
+
+    def r_migrate_delta(self):
+        body = self._json_body()
+        frame = self.api.migrate_delta(body.get("token", ""))
+        self._send(200, frame, content_type="application/octet-stream")
+
+    def r_migrate_end(self):
+        body = self._json_body()
+        self._send_json(200, self.api.migrate_end(body.get("token", "")))
+
+    def r_migrate_fetch(self):
+        self._send_json(200, self.api.migrate_fetch(self._json_body()))
+
+    def r_migrate_finalize(self):
+        self._send_json(200, self.api.migrate_finalize(self._json_body()))
+
+    def r_resize_resume(self):
+        self._send_json(200, self.api.resize_resume())
 
     def r_cluster_message(self):
         self._send_json(200, self.api.receive_message(self._json_body()))

@@ -30,6 +30,122 @@ from pilosa_tpu.shardwidth import SHARD_WORDS
 from pilosa_tpu.storage.disk import HolderStore
 
 
+class ResizeWatchdog:
+    """Follower-side backstop for a coordinator that dies mid-resize.
+
+    A node that received MSG_RESIZE_PREPARE but never hears the commit
+    or cancel would hold its pending membership forever (the legacy
+    equivalent: a node gated in RESIZING with nobody left to lift the
+    gate).  This loop watches for resize state that outlives
+    ``deadline`` and then re-requests the cluster status straight from
+    the coordinator:
+
+    * coordinator reachable and still resizing -> not stuck; re-arm.
+    * coordinator reachable, no resize in flight -> this node missed
+      the commit/cancel broadcast; apply the authoritative status
+      (membership + state) as if the broadcast had arrived.
+    * coordinator unreachable -> keep the pending state (the data is
+      still placed on the current ring) and retry next deadline; the
+      operator path is set_coordinator + resize resume/abort.
+
+    Every action lands on the event journal as ``resize-watchdog``.
+    """
+
+    def __init__(self, node: "NodeServer", deadline: float = 15.0,
+                 interval: float = 2.0):
+        self.node = node
+        self.deadline = float(deadline)
+        self.interval = min(float(interval), max(0.05, self.deadline / 3))
+        self._since: float | None = None
+        self._stop = None
+        self._thread = None
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="resize-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:  # graftlint: disable=exception-hygiene -- watchdog must outlive any single bad tick
+                logger.exception("resize watchdog tick failed")
+
+    def _tick(self) -> None:
+        import time
+
+        from pilosa_tpu.cluster.cluster import STATE_RESIZING
+
+        cluster = self.node.cluster
+        stuck = cluster.resize_pending or cluster.state == STATE_RESIZING
+        if not stuck or cluster.is_coordinator:
+            # The coordinator's own pending state is the resize journal's
+            # concern (resume/abort), not the watchdog's.
+            self._since = None
+            return
+        now = time.monotonic()
+        if self._since is None:
+            self._since = now
+            return
+        if now - self._since < self.deadline:
+            return
+        self._since = now  # one probe per deadline window
+        coord = cluster.node(cluster.coordinator_id)
+        journal = self.node.holder.events
+        if coord is None or not coord.uri:
+            journal.record(
+                ev.EVENT_RESIZE_WATCHDOG, action="no-coordinator",
+                coordinator=cluster.coordinator_id,
+            )
+            return
+        try:
+            status = self.node.client.status(coord.uri)
+        except Exception as e:
+            journal.record(
+                ev.EVENT_RESIZE_WATCHDOG, action="coordinator-unreachable",
+                coordinator=coord.id, error=f"{type(e).__name__}: {e}",
+            )
+            return
+        if status.get("resizePending"):
+            # Coordinator alive and mid-migration: a long resize is not a
+            # stuck resize.
+            journal.record(
+                ev.EVENT_RESIZE_WATCHDOG, action="still-resizing",
+                coordinator=coord.id,
+            )
+            return
+        # The coordinator has no resize in flight — this node missed the
+        # commit or cancel.  Apply its authoritative status as if the
+        # broadcast had arrived.
+        self.node.api.receive_message(
+            {
+                "type": bc.MSG_CLUSTER_STATUS,
+                "state": status.get("state", cluster.state),
+                "coordinator": status.get("coordinator", coord.id),
+                "nodes": status.get("nodes") or [],
+                "availableShards": status.get("availableShards"),
+            }
+        )
+        journal.record(
+            ev.EVENT_RESIZE_WATCHDOG, action="recovered",
+            coordinator=coord.id, state=status.get("state"),
+        )
+
+
 class NodeServer:
     def __init__(
         self,
@@ -69,6 +185,7 @@ class NodeServer:
         flightrec_sample_interval: float = 0.025,
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
+        resize_watchdog_deadline: float = 15.0,
     ):
         self.host = host
         self.tls = bool(tls_cert)
@@ -222,6 +339,13 @@ class NodeServer:
         )
         self.membership = None  # started on demand via start_membership()
         self._ae_loop = None  # anti-entropy loop (start_anti_entropy)
+        # Stuck-resize backstop (0 disables — single-node tests don't
+        # need the thread).
+        self.resize_watchdog = None
+        if resize_watchdog_deadline > 0:
+            self.resize_watchdog = ResizeWatchdog(
+                self, deadline=resize_watchdog_deadline
+            )
 
     # -- shard availability broadcasts (reference view.go:239-261
     #    CreateShardMessage) ------------------------------------------------
@@ -290,6 +414,8 @@ class NodeServer:
         self.runtime_monitor.start()
         if self.flightrec is not None:
             self.flightrec.start()
+        if self.resize_watchdog is not None:
+            self.resize_watchdog.start()
         self.holder.events.record(
             ev.EVENT_NODE_START, uri=self.uri, state=self.api.state
         )
@@ -417,6 +543,8 @@ class NodeServer:
             self.membership.stop()
         if self.api.dist is not None:
             self.api.dist.close()
+        if self.resize_watchdog is not None:
+            self.resize_watchdog.stop()
         if self.flightrec is not None:
             self.flightrec.stop()
         self.runtime_monitor.stop()

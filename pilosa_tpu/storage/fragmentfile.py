@@ -68,6 +68,12 @@ class FragmentFile:
         self._batch_depth = 0
         self._batch_add: list[np.ndarray] = []
         self._batch_remove: list[np.ndarray] = []
+        # Migration delta taps (cluster/migration.py): while a shard
+        # streams to a new owner, a tap pinned here mirrors every
+        # appended record so the target can replay writes that landed
+        # after its snapshot cut.  Fed under the store lock — tap order
+        # matches file order exactly.
+        self._taps: list = []
         fragment.store = self
 
     # -- load ---------------------------------------------------------------
@@ -189,8 +195,23 @@ class FragmentFile:
                 os.fsync(self._fh.fileno())
             self.op_n += count
             self.mut_seq += 1
+            for tap in self._taps:
+                tap.feed(records, count)
         if self.op_n > MAX_OP_N:
             self.request_snapshot()
+
+    # -- migration taps -----------------------------------------------------
+
+    def add_tap(self, tap) -> None:
+        with self._lock:
+            self._taps.append(tap)
+
+    def remove_tap(self, tap) -> None:
+        with self._lock:
+            try:
+                self._taps.remove(tap)
+            except ValueError:
+                pass
 
     def check_row(self, row: int) -> None:
         """Raise BEFORE any mutation if a row id cannot be persisted

@@ -210,6 +210,7 @@ class InProcessCluster:
         peer: str | None = None,
         route: str | None = None,
         path: str | None = None,
+        stage: str | None = None,
         delay: float = 0.0,
         code: int = 503,
         times: int | None = None,
@@ -231,7 +232,7 @@ class InProcessCluster:
                 raise ValueError("pass node OR peer, not both")
             peer = urllib.parse.urlsplit(self.nodes[node].uri).netloc
         return self.fault_registry(seed=seed).add(
-            kind, peer=peer, route=route, path=path,
+            kind, peer=peer, route=route, path=path, stage=stage,
             delay=delay, code=code, times=times, p=p,
         )
 

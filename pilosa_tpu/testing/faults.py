@@ -34,7 +34,18 @@ import time
 
 KINDS_NETWORK = ("reset", "slow", "error")
 KINDS_DISK = ("disk_write_fail",)
-KINDS = KINDS_NETWORK + KINDS_DISK
+# "crash" fires at named protocol stages (resize/migration phase
+# boundaries call ``stage_fault("coordinator:flip")`` etc.) and raises
+# CrashError there — a surgical stand-in for killing that participant
+# at exactly that point in the protocol.
+KINDS_STAGE = ("crash",)
+KINDS = KINDS_NETWORK + KINDS_DISK + KINDS_STAGE
+
+
+class CrashError(RuntimeError):
+    """Raised by a fired ``crash`` rule: the participant 'dies' at this
+    protocol stage (the surrounding code must treat it like any other
+    unexpected failure)."""
 
 
 class Fault:
@@ -46,6 +57,7 @@ class Fault:
         peer: str | None = None,
         route: str | None = None,
         path: str | None = None,
+        stage: str | None = None,
         delay: float = 0.0,
         code: int = 503,
         times: int | None = None,
@@ -57,6 +69,7 @@ class Fault:
         self.peer = peer      # fnmatch on netloc, e.g. "127.0.0.1:91*"
         self.route = route    # fnmatch on request path, e.g. "/index/*"
         self.path = path      # fnmatch on file path (disk faults)
+        self.stage = stage    # fnmatch on stage name (crash faults)
         self.delay = float(delay)
         self.code = int(code)
         self.times = times    # remaining firings; None = unlimited
@@ -76,6 +89,11 @@ class Fault:
         if self.kind not in KINDS_DISK:
             return False
         return self.path is None or fnmatch.fnmatch(path, self.path)
+
+    def matches_stage(self, stage: str) -> bool:
+        if self.kind not in KINDS_STAGE:
+            return False
+        return self.stage is None or fnmatch.fnmatch(stage, self.stage)
 
 
 class FaultRegistry:
@@ -170,6 +188,20 @@ class FaultRegistry:
             self._notify(fired, path)
             raise OSError(f"fault-injected disk write failure: {path}")
 
+    def stage_fault(self, stage: str) -> None:
+        """Crash the caller at a named protocol stage.  Stage names are
+        ``<role>:<phase>`` (e.g. ``coordinator:flip``, ``source:chunk``,
+        ``target:apply``); rules fnmatch against them."""
+        with self._lock:
+            fired = None
+            for fault in self._faults:
+                if fault.matches_stage(stage) and self._fire(fault):
+                    fired = fault
+                    break
+        if fired is not None:
+            self._notify(fired, stage)
+            raise CrashError(f"fault-injected crash at stage: {stage}")
+
     def _notify(self, fault: Fault, target: str) -> None:
         """Invoke the observer (no lock held); observer bugs never mask
         the fault being injected."""
@@ -220,3 +252,10 @@ def disk_write_fault(path: str) -> None:
     registry = _active
     if registry is not None:
         registry.disk_write_fault(path)
+
+
+def stage_fault(stage: str) -> None:
+    """Hook point: called at resize/migration protocol stage boundaries."""
+    registry = _active
+    if registry is not None:
+        registry.stage_fault(stage)

@@ -288,3 +288,266 @@ def test_attr_anti_entropy_converges():
                 "rank": 1,
             }, n.node_id
             assert n.holder.index("ai").column_attrs.attrs(123) == {"tag": "x"}
+
+
+# -- online resize (per-fragment migration, no cluster-wide gate) ------------
+
+
+def _event_types(node):
+    return [e["type"] for e in node.holder.events.since(0)["events"]]
+
+
+def test_resize_stays_online_under_concurrent_writes():
+    """The tentpole property: add_node while a writer hammers the
+    cluster.  No write window closes (the cluster never leaves NORMAL),
+    every accepted write survives the migration, and the coordinator's
+    journal shows the per-fragment timeline: resize-start ->
+    migrate-fragment/epoch-flip per shard group -> resize-commit."""
+    import threading
+
+    with InProcessCluster(2, replica_n=2) as c:
+        c.create_index("on")
+        c.create_field("on", "f")
+        n_shards = 8
+        base = [(0, s * SHARD_WIDTH + s) for s in range(n_shards)]
+        c.import_bits("on", "f", base)
+        accepted: list[int] = []
+        stop = threading.Event()
+        errors: list[Exception] = []
+
+        def writer():
+            k = 0
+            while not stop.is_set():
+                col = (k % n_shards) * SHARD_WIDTH + 1000 + k
+                try:
+                    c.query(0, "on", f"Set({col}, f=0)")
+                    accepted.append(col)
+                except Exception as e:  # graftlint: disable=exception-hygiene -- chaos writer: collected and asserted empty below
+                    errors.append(e)
+                k += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            new = c.add_node()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errors, f"writes failed during online resize: {errors[:3]}"
+        assert accepted, "writer never got a write in during the resize"
+        # cluster stayed NORMAL on every member the whole time: the event
+        # journal records every state transition, and none happened
+        for n in c.nodes:
+            assert n.cluster.state == "NORMAL"
+            assert "cluster-state" not in _event_types(n), n.node_id
+        # every accepted write is readable from every node (one
+        # anti-entropy pass first: a write racing the final post-flip
+        # drain may land replica-only until repair)
+        c.sync_all()
+        want = len({col for _, col in base} | set(accepted))
+        for i in range(3):
+            got = c.query(i, "on", "Count(Row(f=0))")["results"][0]
+            assert got == want, f"node {i}: {got} != {want}"
+        # coordinator journal shows the migration timeline
+        types = _event_types(c.coordinator)
+        assert "resize-start" in types
+        assert "migrate-fragment" in types
+        assert "epoch-flip" in types
+        assert "resize-commit" in types
+        assert types.index("resize-start") < types.index("resize-commit")
+        # the new node saw per-shard flips and holds what it owns
+        assert _local_shards(new, "on", "f"), "new node took no shards"
+
+
+def test_resize_source_crash_retries_and_completes():
+    """A source dying at migrate-begin is retried with seeded backoff;
+    the resize still completes and no data is lost."""
+    with InProcessCluster(2, replica_n=1) as c:
+        c.create_index("sc")
+        c.create_field("sc", "f")
+        c.import_bits("sc", "f", [(0, s * SHARD_WIDTH) for s in range(8)])
+        fault = c.inject_fault("crash", stage="source:begin", times=1)
+        c.add_node()
+        assert fault.hits == 1, "crash rule never fired"
+        for i in range(3):
+            assert c.query(i, "sc", "Count(Row(f=0))")["results"][0] == 8
+        stats = c.sync_all()
+        assert stats.get("bits_set", 0) == 0
+        assert stats.get("bits_cleared", 0) == 0
+
+
+def test_resize_resume_after_coordinator_crash():
+    """Coordinator dies mid-migration (injected at the flip stage): the
+    persisted journal survives, the cluster keeps serving reads, and
+    resume() re-dispatches idempotently to completion."""
+    import os
+
+    import pytest
+
+    from pilosa_tpu.testing import faults as f
+
+    with InProcessCluster(3, replica_n=1, with_disk=True) as c:
+        c.create_index("cr")
+        c.create_field("cr", "f")
+        n_shards = 10
+        c.import_bits("cr", "f", [(3, s * SHARD_WIDTH) for s in range(n_shards)])
+        victim = next(
+            n for n in c.nodes if n.node_id != c.coordinator_id
+        )
+        c.inject_fault("crash", stage="coordinator:flip", times=1)
+        with pytest.raises(f.CrashError):
+            c.coordinator.resize_coordinator().remove_node(victim.node_id)
+        # the crash left a resumable plan, not a wedged cluster
+        journal_path = os.path.join(c.coordinator.store.path, "resize.json")
+        assert os.path.exists(journal_path), "resize journal not persisted"
+        for i in range(3):
+            got = c.query(i, "cr", "Count(Row(f=3))")["results"][0]
+            assert got == n_shards, f"node {i} unreadable after crash"
+        out = c.coordinator.api.resize_resume()
+        assert out["resumed"] is True
+        assert not os.path.exists(journal_path), "journal outlived commit"
+        survivors = [n for n in c.nodes if n is not victim]
+        for n in survivors:
+            assert len(n.cluster.nodes) == 2, n.node_id
+            assert n.cluster.state == "NORMAL"
+            assert not n.cluster.resize_pending
+        for i, n in enumerate(c.nodes):
+            if n is victim:
+                continue
+            got = c.query(i, "cr", "Count(Row(f=3))")["results"][0]
+            assert got == n_shards
+        types = _event_types(c.coordinator)
+        assert "resize-resume" in types
+        assert "resize-commit" in types
+        # keep teardown honest: victim is out of the membership but the
+        # process is still ours to stop
+        assert not any(
+            nn.id == victim.node_id for nn in survivors[0].cluster.nodes
+        )
+
+
+def test_resize_resume_without_journal_is_an_error():
+    import pytest
+
+    from pilosa_tpu.server.api import ApiError
+
+    with InProcessCluster(2, replica_n=1) as c:
+        with pytest.raises(ApiError, match="no interrupted resize"):
+            c.coordinator.api.resize_resume()
+
+
+def test_resize_aborts_when_surviving_member_unreachable():
+    """An unreachable SURVIVING member must abort the resize at prepare:
+    committing a membership it never heard of would strand it on the old
+    ring (the old code only warned and carried on)."""
+    import pytest
+
+    from pilosa_tpu.cluster.resize import ResizeError
+
+    with InProcessCluster(3, replica_n=2) as c:
+        for n in c.nodes:
+            n.client.timeout = 2.0
+        c.create_index("ab")
+        c.create_field("ab", "f")
+        c.import_bits("ab", "f", [(1, s * SHARD_WIDTH) for s in range(6)])
+        bystander = next(
+            i for i, n in enumerate(c.nodes)
+            if n.node_id != c.coordinator_id
+        )
+        c.pause_node(bystander)
+        try:
+            with pytest.raises(ResizeError, match="surviving member"):
+                c.add_node()
+        finally:
+            c.resume_node(bystander)
+        # membership unchanged, no pending state leaked anywhere
+        for n in c.nodes:
+            assert len(n.cluster.nodes) == 3, n.node_id
+            assert not n.cluster.resize_pending, n.node_id
+        assert len(c.nodes) == 3
+        for i in range(3):
+            assert c.query(i, "ab", "Count(Row(f=1))")["results"][0] == 6
+
+
+def test_resize_dead_node_removal_journals_data_loss():
+    """Removing a DEAD node with replica_n=1 loses its un-replicated
+    fragments; the loss must surface as a resize-data-loss event plus a
+    /metrics counter — never a silent skip."""
+    with InProcessCluster(3, replica_n=1) as c:
+        for n in c.nodes:
+            n.client.timeout = 2.0
+        c.create_index("dl")
+        c.create_field("dl", "f")
+        n_shards = 12
+        c.import_bits("dl", "f", [(0, s * SHARD_WIDTH) for s in range(n_shards)])
+        victim_i = next(
+            i for i, n in enumerate(c.nodes)
+            if n.node_id != c.coordinator_id
+            and _local_shards(n, "dl", "f")
+        )
+        victim = c.nodes[victim_i]
+        lost_shards = _local_shards(victim, "dl", "f")
+        # the victim's un-replicated fragments span the user field AND
+        # its companion _exists field — both count as lost
+        n_lost = len(lost_shards) + len(_local_shards(victim, "dl", "_exists"))
+        # pause first so pooled keep-alive connections can't sneak one
+        # last inventory response out of the dying node, then stop it
+        c.pause_node(victim_i)
+        victim.stop()  # hard death: un-replicated fragments are gone
+        c.nodes.pop(victim_i)
+        c.coordinator.resize_coordinator().remove_node(victim.node_id)
+        events = c.coordinator.holder.events.since(0)["events"]
+        loss = [e for e in events if e["type"] == "resize-data-loss"]
+        assert loss, "data loss was not journaled"
+        assert loss[0]["data"]["count"] == n_lost
+        assert loss[0]["data"]["node"] == victim.node_id
+        counters = c.coordinator.holder.stats.snapshot()["counters"]
+        assert any(
+            k.startswith("resize_data_loss_fragments") and v == n_lost
+            for k, v in counters.items()
+        ), counters
+        # the surviving fragments still answer
+        want = n_shards - len(lost_shards)
+        for i in range(2):
+            assert c.query(i, "dl", "Count(Row(f=0))")["results"][0] == want
+
+
+def test_resize_watchdog_recovers_missed_commit():
+    """A node that received resize-prepare but missed the commit/cancel
+    broadcast re-pulls the authoritative status from the coordinator
+    once the deadline passes, instead of holding pending state forever."""
+    import time as _time
+
+    from pilosa_tpu.cluster import broadcast as bc
+    from pilosa_tpu.server.node import ResizeWatchdog
+
+    with InProcessCluster(2, replica_n=1) as c:
+        follower = next(
+            n for n in c.nodes if n.node_id != c.coordinator_id
+        )
+        # simulate a prepare whose resize died before commit: only this
+        # follower ever hears it
+        follower.api.receive_message(
+            {
+                "type": bc.MSG_RESIZE_PREPARE,
+                "epoch": follower.cluster.epoch + 1,
+                "nodes": [
+                    {"id": n.id, "uri": n.uri}
+                    for n in follower.cluster.nodes
+                ] + [{"id": "zzz-ghost", "uri": "http://127.0.0.1:1"}],
+            }
+        )
+        assert follower.cluster.resize_pending
+        wd = ResizeWatchdog(follower, deadline=0.01)
+        wd._tick()  # arms the timer
+        _time.sleep(0.02)
+        wd._tick()  # past deadline: probes the coordinator and recovers
+        assert not follower.cluster.resize_pending
+        assert follower.cluster.state == "NORMAL"
+        events = follower.holder.events.since(0)["events"]
+        acts = [
+            e["data"].get("action")
+            for e in events
+            if e["type"] == "resize-watchdog"
+        ]
+        assert "recovered" in acts, acts

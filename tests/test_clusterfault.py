@@ -235,3 +235,103 @@ def test_fault_registry_is_deterministic():
     assert a == b
     assert any(a) and not all(a), "p=0.5 should fire sometimes, not always"
     assert pattern(seed=8) != a
+
+
+# -- online resize under chaos (crash any participant at any phase) ----------
+
+
+def _row_count(cluster, node_i, index="ci", row=1):
+    return cluster.query(node_i, index, f"Count(Row(cf={row}))")["results"][0]
+
+
+def _spread_shards(c, n_shards=12):
+    """Row 2 spread over many shards so a membership change is certain
+    to move SOME fragment (the fixture's 200 bits span only 3 shards)."""
+    width = c.nodes[0].holder.n_words * 32
+    c.import_bits("ci", "cf", [(2, s * width) for s in range(n_shards)])
+    return n_shards
+
+
+def test_resize_target_crash_aborts_and_cluster_stays_consistent(chaos_cluster):
+    """The migration target dies applying the snapshot: only its
+    instructions abort, the coordinator cancels the resize, and every
+    surviving node keeps serving the pre-resize data with zero repairs
+    owed (the targets only ever held copies)."""
+    c = chaos_cluster
+    n_spread = _spread_shards(c)
+    fault = c.inject_fault("crash", stage="target:apply")
+    with pytest.raises(Exception):
+        c.add_node()
+    assert fault.hits > 0, "target:apply rule never fired"
+    c.clear_faults()
+    assert len(c.nodes) == 3
+    for n in c.nodes:
+        assert len(n.cluster.nodes) == 3, n.node_id
+        assert n.cluster.state == "NORMAL", n.node_id
+        assert not n.cluster.resize_pending, n.node_id
+    for i in range(3):
+        assert _row_count(c, i) == c.expected, f"node {i}"
+        assert _row_count(c, i, row=2) == n_spread, f"node {i}"
+    stats = c.sync_all()
+    assert stats.get("bits_set", 0) == 0, stats
+    assert stats.get("bits_cleared", 0) == 0, stats
+
+
+@pytest.mark.parametrize("stage", ["source:chunk", "source:delta"])
+def test_resize_source_crash_midstream_retries(chaos_cluster, stage):
+    """A source dying mid-snapshot-stream or mid-catch-up is retried
+    (same fragment, seeded backoff); the resize completes and anti-
+    entropy finds nothing to repair."""
+    c = chaos_cluster
+    n_spread = _spread_shards(c)
+    fault = c.inject_fault("crash", stage=stage, times=1)
+    new = c.add_node()
+    assert fault.hits == 1, f"{stage} rule never fired"
+    for i in range(4):
+        assert _row_count(c, i) == c.expected, f"node {i}"
+        assert _row_count(c, i, row=2) == n_spread, f"node {i}"
+    stats = c.sync_all()
+    assert stats.get("bits_set", 0) == 0, stats
+    assert stats.get("bits_cleared", 0) == 0, stats
+    assert new in c.nodes
+
+
+@pytest.mark.parametrize(
+    "stage", ["coordinator:prepare", "coordinator:migrate", "coordinator:commit"]
+)
+def test_resize_coordinator_crash_leaves_resumable_plan(chaos_cluster, stage):
+    """Kill the coordinator at each phase boundary: reads keep flowing
+    everywhere, the journaled plan resumes to a committed membership,
+    and the final cluster owes anti-entropy nothing."""
+    c = chaos_cluster
+    n_spread = _spread_shards(c)
+    victim = next(
+        n for n in c.nodes if n.node_id != c.coordinator_id
+    )
+    c.inject_fault("crash", stage=stage, times=1)
+    with pytest.raises(faults.CrashError):
+        c.coordinator.resize_coordinator().remove_node(victim.node_id)
+    # the cluster keeps serving reads mid-crash from every live node
+    for i, n in enumerate(c.nodes):
+        assert _row_count(c, i) == c.expected, f"node {i} during crash"
+    out = c.coordinator.api.resize_resume()
+    assert out["resumed"] is True
+    survivors = [n for n in c.nodes if n is not victim]
+    for n in survivors:
+        assert len(n.cluster.nodes) == 2, n.node_id
+        assert n.cluster.state == "NORMAL", n.node_id
+        assert not n.cluster.resize_pending, n.node_id
+    for i, n in enumerate(c.nodes):
+        if n is victim:
+            continue
+        assert _row_count(c, i) == c.expected, f"node {i} after resume"
+        assert _row_count(c, i, row=2) == n_spread, f"node {i} after resume"
+    # put the victim's process out of the pool so sync_all only runs on
+    # members (the process itself is torn down by the fixture)
+    stats_nodes = survivors
+    total = {}
+    for n in stats_nodes:
+        for k, v in n.syncer().sync_holder().items():
+            total[k] = total.get(k, 0) + v
+    assert total.get("bits_set", 0) == 0, total
+    assert total.get("bits_cleared", 0) == 0, total

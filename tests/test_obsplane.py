@@ -171,7 +171,9 @@ def test_add_node_is_journaled_and_job_runs_to_done():
             e["data"]["phase"] for e in out["events"]
             if e["type"] == "resize-phase"
         ]
-        assert phases == ["broadcast-resizing", "inventory", "migrate", "commit"]
+        # coordinator job walk + the coordinator receiving its own
+        # resize-prepare broadcast (hence "prepare" twice)
+        assert phases == ["prepare", "prepare", "inventory", "migrate", "commit"]
         assert "node-join" in types
         assert "antientropy-round" in types
         # cursor resume from nextSeq: no duplicates, no gap
@@ -180,10 +182,17 @@ def test_add_node_is_journaled_and_job_runs_to_done():
 
         jobs = _get(coord.uri, "/debug/jobs?kind=resize")
         [job] = [j for j in jobs["jobs"] if j["status"] == "done"]
-        prog = job["progress"]
-        assert prog["fragments_done"] == prog["fragments_total"] > 0
-        assert job["percent"] == 100.0
         assert job["error"] is None
+        # the online protocol counts only MIGRATING fragments; whether
+        # any shard moves on a 2->3 add depends on where the new node's
+        # random id lands in the ring, so progress is asserted
+        # consistent rather than non-zero (forced-movement coverage
+        # lives in tests/test_antientropy_resize.py)
+        prog = job["progress"]
+        assert prog.get("fragments_done", 0) == prog.get("fragments_total", 0)
+        assert prog.get("shards_done", 0) == prog.get("shards_total", 0)
+        if prog.get("fragments_total"):
+            assert job["percent"] == 100.0
         # job boards are per-node and the import-drain job runs on the
         # shard OWNER (imports route shard-wise; jump hash over random
         # node ids decides placement), so collect done kinds cluster-wide

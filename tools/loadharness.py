@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from pilosa_tpu.loadgen import (
     StageSpec,
@@ -73,6 +74,25 @@ def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec
     ]
 
 
+def resize_stage(duration: float, rate: float, workers: int) -> StageSpec:
+    """The membership-churn stage: zipfian read-heavy traffic during
+    which ``resize_hook`` adds a node and then removes one."""
+    return StageSpec("resize", duration, rate, workers, READ_HEAVY_MIX)
+
+
+def resize_hook(cluster, settle: float = 0.4) -> None:
+    """Run concurrently with the resize stage's traffic: let the zipfian
+    load establish, grow the cluster by one node (per-fragment migration
+    under live writes), let the new topology serve, then shrink it back
+    out.  Both resizes ride the online protocol — the stage's
+    availability verdict is the proof no cluster-wide gate dropped
+    requests."""
+    time.sleep(settle)
+    node = cluster.add_node()
+    time.sleep(settle)
+    cluster.remove_node(cluster.nodes.index(node))
+
+
 def parse_fault(spec: str) -> dict:
     """``kind[,k=v...]`` -> inject_fault kwargs, e.g.
     ``slow,node=1,delay=0.05,p=0.5``."""
@@ -108,6 +128,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="inject a fault rule, e.g. slow,node=1,delay=0.05")
     ap.add_argument("--default-deadline", type=float, default=0.0,
                     help="server-side default request deadline (seconds)")
+    ap.add_argument("--resize", action="store_true",
+                    help="append a resize stage: add a node mid-zipfian"
+                         " traffic, then remove one (online per-fragment"
+                         " migration under load)")
     ap.add_argument("--print-sequence", action="store_true",
                     help="print the deterministic op sequence as JSON lines"
                          " and exit (no cluster, no load)")
@@ -119,6 +143,11 @@ def main(argv: list[str] | None = None) -> int:
 
     config = WorkloadConfig(seed=args.seed)
     stages = default_stages(args.duration, args.rate, args.workers)
+    stage_hooks = {}
+    if args.resize:
+        quarter = max(1.5, args.duration / 4.0)
+        stages.append(resize_stage(quarter, args.rate, args.workers))
+        stage_hooks["resize"] = resize_hook
 
     if args.print_sequence:
         gen = WorkloadGenerator(config)
@@ -139,6 +168,7 @@ def main(argv: list[str] | None = None) -> int:
         },
         faults=[parse_fault(f) for f in args.fault],
         preload_bits=args.preload_bits,
+        stage_hooks=stage_hooks,
     )
     validate_report(report)
     path = args.report or next_report_path(args.report_dir)
@@ -157,6 +187,12 @@ def main(argv: list[str] | None = None) -> int:
             f"  {name:<14} n={c['count']:<6} err={c['errors']:<4} "
             f"p50={c['p50Ms']:.2f}ms p99={c['p99Ms']:.2f}ms "
             f"p999={c['p999Ms']:.2f}ms"
+        )
+    for st in report["stages"]:
+        print(
+            f"  stage {st['name']:<14} avail={st['availability']:.4f} "
+            f"{'OK' if st['availabilityOk'] else 'LOW'}"
+            + (f" hookError={st['hookError']}" if st.get("hookError") else "")
         )
     for name, v in report["verdicts"].items():
         print(f"  verdict {name:<14} {'PASS' if v['pass'] else 'FAIL'}")

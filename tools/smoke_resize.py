@@ -1,0 +1,97 @@
+"""Online-resize smoke: drive zipfian traffic at a small cluster while a
+node is added and then removed, and assert the resize stayed invisible
+to clients.
+
+Asserts:
+  * the resize stage completed its hook (add node mid-traffic, then
+    remove it) with no hook error
+  * the stage's availability verdict is green — membership changes must
+    not open a cluster-wide error window (the old RESIZING gate would
+    have failed every request for the duration)
+  * /debug/events carries the full resize timeline: two resize-start /
+    resize-commit pairs (grow + shrink), per-fragment migrate-fragment
+    records, epoch-flip broadcasts, and no resize-abort
+  * the error budget stayed green: no burn-rate alert fired in any SLO
+    class during the run (latency objectives are NOT asserted — short
+    cold-start runs legitimately blow them, see tools/loadharness.py)
+  * the emitted report validates against pilosa-slo-report/v1
+
+Run: python -m tools.smoke_resize      (CI: resize smoke step)
+"""
+
+from __future__ import annotations
+
+import sys
+
+from pilosa_tpu.loadgen import (
+    WorkloadConfig,
+    run_harness,
+    validate_report,
+)
+from tools.loadharness import SHORT_BURN_RULES, resize_hook, resize_stage
+
+# Small shards (128 words = 4096 columns) so the zipfian key space spans
+# ~10 shard groups and the add/remove resizes are guaranteed to migrate
+# fragments rather than no-op on a single-shard layout.
+N_WORDS = 128
+N_COLS = 40_000
+
+
+def main() -> int:
+    config = WorkloadConfig(seed=2026, n_cols=N_COLS)
+    stage = resize_stage(duration=2.5, rate=80.0, workers=4)
+    report = run_harness(
+        config,
+        [stage],
+        nodes=2,
+        cluster_kwargs={
+            "replica_n": 2,
+            "n_words": N_WORDS,
+            "slo_burn_rules": SHORT_BURN_RULES,
+            "slo_slot_seconds": 1.0,
+            "slo_latency_window": 60.0,
+        },
+        preload_bits=2048,
+        stage_hooks={"resize": resize_hook},
+    )
+    validate_report(report)
+
+    st = report["stages"][0]
+    assert st["hookRan"], "resize hook never started"
+    assert st["hookError"] is None, f"resize hook failed: {st['hookError']}"
+    assert st["availabilityOk"], (
+        f"resize stage availability {st['availability']:.4f} below floor "
+        f"({st['okOps']}/{st['ops']} ok, {st['clientErrors']} client errors)"
+    )
+
+    # resize timeline from the coordinator's event journal (rides in the
+    # report so SLO_r*.json is self-contained evidence)
+    types = [e["type"] for e in report["events"]]
+    assert types.count("resize-start") == 2, types
+    assert types.count("resize-commit") == 2, types
+    assert "migrate-fragment" in types, "no fragment migrated during resize"
+    assert "epoch-flip" in types, "no epoch flip broadcast during resize"
+    assert "resize-abort" not in types, "a resize aborted mid-smoke"
+    # ordering: first start precedes first commit precedes second start
+    assert types.index("resize-start") < types.index("resize-commit")
+    assert types.index("resize-commit") < _rindex(types, "resize-start")
+
+    # green error budget: no burn-rate alert fired in any class
+    for name, cls in report["serverSLO"]["classes"].items():
+        firing = [r for r, on in (cls.get("alerts") or {}).items() if on]
+        assert not firing, f"burn alert(s) {firing} fired for class {name}"
+
+    print(
+        f"resize smoke OK: availability={st['availability']:.4f} "
+        f"migrations={types.count('migrate-fragment')} "
+        f"flips={types.count('epoch-flip')}"
+    )
+    return 0
+
+
+def _rindex(seq: list, value) -> int:
+    return len(seq) - 1 - seq[::-1].index(value)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
