@@ -432,6 +432,117 @@ def _recorder_overhead_lane() -> dict:
     }
 
 
+def _mesh_dist_lane() -> dict:
+    """Cluster-on-mesh lane: distributed Count/TopN/Range on an in-mesh
+    8-way InProcessCluster — every owner's fragments are slices of the
+    local serving mesh, so the whole fan-out is ONE jit-sharded launch
+    (cluster/dist.py + cluster/meshexec.py) — against the same data on a
+    single holder.  Zero HTTP subrequests is ASSERTED, not assumed: the
+    lane counts ``client.query_node`` calls across all eight nodes and
+    fails if any leg left the process.  Both sides ride the same
+    admission-batcher API path and are measured in interleaved
+    best-of-3 blocks (drift hits both sides; see the recorder lane)."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.testing import InProcessCluster
+
+    def seed(target):
+        target.create_index("md")
+        target.create_field("md", "f")
+        target.create_field(
+            "md", "v", {"type": "int", "min": 0, "max": 1_000_000}
+        )
+        rng = np.random.default_rng(29)
+        bits = [
+            (r, s * SHARD_WIDTH + int(c))
+            # distinct per-row sizes keep TopN free of count ties, so
+            # the two sides' orderings are comparable bit for bit
+            for r in range(4)
+            for s in range(16)
+            for c in rng.integers(0, SHARD_WIDTH, size=40 + 10 * r)
+        ]
+        target.import_bits("md", "f", bits)
+        cols = sorted(
+            {
+                s * SHARD_WIDTH + int(c)
+                for s in range(16)
+                for c in rng.integers(0, SHARD_WIDTH, size=60)
+            }
+        )
+        target.import_values("md", "v", cols, [c % 999_983 for c in cols])
+
+    queries = {
+        "count": "Count(Row(f=1))",
+        "topn": "TopN(f, n=5)",
+        "range": "Count(Row(v > 500000))",
+    }
+    http_calls = []
+    with InProcessCluster(8, replica_n=1) as mesh_c, InProcessCluster(
+        1
+    ) as solo_c:
+        seed(mesh_c)
+        seed(solo_c)
+        qi = next(
+            i
+            for i, n in enumerate(mesh_c.nodes)
+            if n.node_id == mesh_c.coordinator_id
+        )
+        api_m = mesh_c.nodes[qi].api
+        api_s = solo_c.nodes[0].api
+        for n in mesh_c.nodes:
+            orig = n.client.query_node
+
+            def wrap(*a, _o=orig, **k):
+                http_calls.append(a)
+                return _o(*a, **k)
+
+            n.client.query_node = wrap
+        # warmup doubles as the parity gate: both sides must agree
+        # before either is timed
+        for q in queries.values():
+            want = api_s.query("md", q)["results"]
+            got = api_m.query("md", q)["results"]
+            if got != want:
+                raise RuntimeError(
+                    f"mesh lane parity broke for {q}: {got} != {want}"
+                )
+        reps = {"count": 60, "topn": 30, "range": 30}
+        best = {k: {"mesh": 0.0, "solo": 0.0} for k in queries}
+        for _ in range(3):
+            for key, q in queries.items():
+                for side, api in (("solo", api_s), ("mesh", api_m)):
+                    n_reps = reps[key]
+                    t0 = time.perf_counter()
+                    for _ in range(n_reps):
+                        api.query("md", q)
+                    qps = n_reps / (time.perf_counter() - t0)
+                    best[key][side] = max(best[key][side], qps)
+        snap = api_m.dist.snapshot()
+    if http_calls:
+        raise RuntimeError(
+            f"mesh lane issued {len(http_calls)} HTTP subrequests"
+        )
+    return {
+        "mesh_dist_count_qps": round(best["count"]["mesh"], 1),
+        "mesh_dist_topn_qps": round(best["topn"]["mesh"], 1),
+        "mesh_dist_range_qps": round(best["range"]["mesh"], 1),
+        "single_holder_count_qps": round(best["count"]["solo"], 1),
+        "single_holder_topn_qps": round(best["topn"]["solo"], 1),
+        "single_holder_range_qps": round(best["range"]["solo"], 1),
+        # the acceptance ratio: mesh-dispatched distributed Count vs the
+        # single-holder batched path over identical data (>= 0.5 keeps
+        # it within the 2x bar)
+        "mesh_dist_vs_single_holder": (
+            round(best["count"]["mesh"] / best["count"]["solo"], 3)
+            if best["count"]["solo"]
+            else None
+        ),
+        "http_subrequests": len(http_calls),
+        "nodes": 8,
+        "mesh_dispatches": snap["meshDispatches"],
+        "mesh_fallbacks": snap["meshFallbacks"],
+    }
+
+
 def _np_bsi_lt(planes, exists, sign, value, depth):
     """CPU baseline: the same bit-sliced scan in vectorized numpy."""
     lt = np.zeros_like(exists)
@@ -803,6 +914,15 @@ def main() -> None:
         recorder_lane = _recorder_overhead_lane()
     except Exception as e:
         print(f"warning: recorder overhead lane failed: {e}", file=sys.stderr)
+
+    # -- cluster-on-mesh lane: distributed Count/TopN/Range answered as
+    # one jit-sharded launch over an in-mesh 8-way cluster, vs the same
+    # data on a single holder (the lane must never sink the bench)
+    mesh_dist_lane = None
+    try:
+        mesh_dist_lane = _mesh_dist_lane()
+    except Exception as e:
+        print(f"warning: mesh_dist lane failed: {e}", file=sys.stderr)
 
     # -- SLO harness lane: a short seeded mixed-workload burst through
     # the full HTTP path with the server's error-budget tracker live
@@ -1300,6 +1420,17 @@ def main() -> None:
         "served_http_sweep": served_sweep,
         "served_http_qps_1_client": served_sweep["levels"][0]["qps"],
         "served_http_qps_1k_clients": served_sweep["levels"][-1]["qps"],
+        # cluster-on-mesh lane: distributed queries over an in-mesh
+        # 8-way cluster with ZERO HTTP subrequests (asserted), vs the
+        # single-holder batched path (docs/serving.md "Cluster on the
+        # mesh")
+        "mesh_dist": mesh_dist_lane,
+        "mesh_dist_count_qps": (
+            (mesh_dist_lane or {}).get("mesh_dist_count_qps")
+        ),
+        "mesh_dist_vs_single_holder": (
+            (mesh_dist_lane or {}).get("mesh_dist_vs_single_holder")
+        ),
         # SLO harness lane (short seeded mixed burst; the full report is
         # in the SLO_r*.json it writes — see docs/observability.md)
         "slo_harness": slo_lane,

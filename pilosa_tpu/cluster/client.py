@@ -289,6 +289,7 @@ class InternalClient:
         self._rng_lock = threading.Lock()
         self._breakers: dict[str, CircuitBreaker] = {}
         self._breakers_lock = threading.Lock()
+        self._netlocs: dict[str, str] = {}  # uri -> netloc (peers only)
         # TLS: a None context means urlopen verifies with the default
         # verifying context; ``ca_cert`` pins a private CA for
         # intra-cluster certs, and verification is only skipped when the
@@ -333,7 +334,13 @@ class InternalClient:
         (and not yet due for a half-open probe).  ``dist`` consults this
         to steer fan-outs toward surviving replicas; it never blocks a
         request that routing decides to send anyway."""
-        netloc = urllib.parse.urlsplit(uri).netloc
+        # memoized: this sits on the per-query routing path and peers
+        # are a small fixed set — parsing the uri each call shows up in
+        # profiles at serving qps
+        netloc = self._netlocs.get(uri)
+        if netloc is None:
+            netloc = urllib.parse.urlsplit(uri).netloc
+            self._netlocs[uri] = netloc
         return self._breaker(netloc).allow()
 
     def _backoff(self, attempt: int) -> float:

@@ -7,6 +7,10 @@ The coordinator of a query (whichever node received it):
 2. fans each call out shard-wise — local shards run on this node's
    executor, remote shard groups travel as re-serialized PQL with
    ``remote=true`` + the target's shard list (reference remoteExec),
+   EXCEPT when the owner is a slice of the local serving mesh
+   (parallel/meshplace.py registry): mesh-local groups are folded with
+   the local group into ONE jit-sharded launch over a read-only holder
+   facade (cluster/meshexec.py) — collectives instead of sockets,
 3. reduces streaming per-call results (union of disjoint-shard bitmap
    segments, count sums, TopN/GroupBy merges),
 4. retries a failed node's shards against the remaining replicas
@@ -20,20 +24,26 @@ writes with no shard affinity broadcast to all nodes.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import contextvars
+import logging
 import threading
 from typing import Any, Callable
 
 from pilosa_tpu import deadline, pql
 from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.cluster.cluster import Cluster
+from pilosa_tpu.cluster.meshexec import MeshHolderView
 from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
 from pilosa_tpu.cluster.wire import decode_results
 from pilosa_tpu.exec.executor import ExecuteError, Executor, IndexNotFoundError
 from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
 from pilosa_tpu.obs import qprofile, tracing
+from pilosa_tpu.parallel import meshplace
 from pilosa_tpu.pql.ast import Call
+
+logger = logging.getLogger(__name__)
 
 # Calls whose result is a Row bitmap (reference executeBitmapCallShard
 # dispatch, executor.go:653-680).
@@ -59,6 +69,14 @@ class DistributedExecutor:
     # fans behind each other; per-executor keeps isolation simple and the
     # thread count small (pool threads only block on remote HTTP I/O).
     _FANOUT_WORKERS = 8
+    # Distinct shard assignments worth keeping warm facade executors for
+    # (assignments only change on membership/breaker events, so steady
+    # state uses exactly one entry).
+    _MESH_CACHE_ENTRIES = 8
+    # Cached mesh plans (one per distinct (index, shard-set)); bigger
+    # than the facade cache because plans are tiny and every served
+    # index's steady-state shard set deserves a slot.
+    _PLAN_CACHE_ENTRIES = 64
 
     def __init__(
         self, holder, cluster: Cluster, client, translator=None,
@@ -86,6 +104,29 @@ class DistributedExecutor:
         self._pool: concurrent.futures.ThreadPoolExecutor | None = None
         self._pool_lock = threading.Lock()
         self._closed = False
+        # Cluster-on-mesh dispatch: owner groups whose node is registered
+        # in the process placement map (parallel/meshplace.py) execute as
+        # one jit-sharded launch over a holder facade instead of an HTTP
+        # relay.  The per-instance flag lets a single executor opt out
+        # (tests that exercise the HTTP plane) without touching the
+        # process-wide registry or env kill switch.
+        self.mesh_enabled = meshplace.enabled()
+        # Facade executors are cached per shard assignment so their
+        # field-stack caches stay warm across queries; bounded LRU since
+        # assignments churn during resizes.
+        self._mesh_cache: collections.OrderedDict = collections.OrderedDict()
+        self._mesh_cache_lock = threading.Lock()
+        # Mesh PLAN cache: shard->owner grouping is pure python hashing
+        # (fnv + jump per shard per query) that dominates the dispatch
+        # cost at high qps; plans are reused while the placement token
+        # (membership + resize progress) is unchanged, and every hit
+        # re-verifies the owners' registry handles so a withdrawn or
+        # restarted peer forces a replan.
+        self._plan_cache: collections.OrderedDict = collections.OrderedDict()
+        self._plan_cache_lock = threading.Lock()
+        self._partition_log: collections.deque = collections.deque(maxlen=32)
+        self.mesh_dispatches = 0
+        self.mesh_fallbacks = 0
 
     def _fanout_pool(self) -> concurrent.futures.ThreadPoolExecutor:
         with self._pool_lock:
@@ -343,6 +384,18 @@ class DistributedExecutor:
             bad_nodes: set[str] = set()
             partials: list[Any] = []
             pending = list(shards)
+            # Partition ladder: mesh collective -> HTTP relay -> replica
+            # failover.  A mesh failure mid-query demotes the REST of the
+            # query to HTTP (mesh_allowed flips) — it never fails the
+            # caller.
+            mesh_allowed = self._mesh_on()
+            stats = self.holder.stats
+            decision = {
+                "call": call.name, "index": index_name,
+                "shards": len(shards), "meshNodes": 0, "meshShards": 0,
+                "httpNodes": 0, "httpShards": 0, "localShards": 0,
+                "meshFallback": False,
+            }
             while pending:
                 # Fail the whole fan-out fast once the request's budget
                 # is spent — re-mapping shards onto replicas is pointless
@@ -363,13 +416,27 @@ class DistributedExecutor:
                         index_name, pending, set()
                     )
                 pending = []
+                # The local shard group ALWAYS runs inline on this
+                # request thread — a saturated fan-out pool (slow remote
+                # I/O) must never queue purely-local work behind sockets.
+                # Mesh-local groups inherit the same invariant: the
+                # collective launch below is inline too, only true HTTP
+                # legs ride the pool.
+                local_shards = groups.pop(self.cluster.node_id, None)
+                mesh_groups = (
+                    self._mesh_owner_handles(groups) if mesh_allowed else {}
+                )
+                http_reason = (
+                    "disabled" if not self._mesh_on()
+                    else "mesh_error" if not mesh_allowed
+                    else "off_mesh"
+                )
                 # Remote nodes are queried CONCURRENTLY (one pool task per
                 # node, the reference's goroutine-per-node mapper,
-                # executor.go:2520-2555) while the local shard group runs
+                # executor.go:2520-2555) while the mesh + local groups run
                 # on the request thread; results are collected in arrival
                 # order and failed nodes' shards re-mapped onto remaining
                 # replicas for the next loop pass.
-                local_shards = groups.pop(self.cluster.node_id, None)
                 futures = {
                     self._submit(
                         self._query_remote,
@@ -381,22 +448,334 @@ class DistributedExecutor:
                     ): (node_id, nshards)
                     for node_id, nshards in groups.items()
                 }
+                for nshards in groups.values():
+                    stats.count_with_tags(
+                        "dist_http_fanout_total", 1, 1.0,
+                        (f"reason:{http_reason}",),
+                    )
+                    decision["httpNodes"] += 1
+                    decision["httpShards"] += len(nshards)
+                if mesh_groups:
+                    try:
+                        partials.append(
+                            self._mesh_execute(
+                                index_name, call, mesh_groups, local_shards
+                            )
+                        )
+                        decision["meshNodes"] += len(mesh_groups) + bool(
+                            local_shards
+                        )
+                        decision["meshShards"] += sum(
+                            len(sh) for _, sh in mesh_groups.values()
+                        ) + len(local_shards or ())
+                        local_shards = None  # folded into the launch
+                    except Exception:
+                        # Fallback ladder: the collective path must never
+                        # fail a query the HTTP relay can still answer —
+                        # log the evidence, demote to HTTP, re-map.
+                        logger.exception(
+                            "mesh dispatch failed for %s on %r; "
+                            "falling back to HTTP fan-out",
+                            call.name, index_name,
+                        )
+                        stats.count("dist_mesh_fallback_total", 1)
+                        self.mesh_fallbacks += 1
+                        decision["meshFallback"] = True
+                        mesh_allowed = False
+                        for _, nshards in mesh_groups.values():
+                            pending.extend(nshards)
                 if local_shards is not None:
+                    decision["localShards"] += len(local_shards)
                     partials.append(
                         self.local._execute_call(idx, call, local_shards)
                     )
-                for fut in concurrent.futures.as_completed(futures):
-                    node_id, nshards = futures[fut]
-                    try:
-                        partials.append(fut.result())
-                    except ClientError:
-                        # Failover: re-map this node's shards onto remaining
-                        # replicas (reference executor.go:2495-2506).
-                        bad_nodes.add(node_id)
-                        pending.extend(nshards)
+                if futures:
+                    fanout = tracing.start_span("dist.httpFanout")
+                    fanout.set_tag("peers", len(futures))
+                    fanout.set_tag("reason", http_reason)
+                    with fanout:
+                        for fut in concurrent.futures.as_completed(futures):
+                            node_id, nshards = futures[fut]
+                            try:
+                                partials.append(fut.result())
+                            except ClientError:
+                                # Failover: re-map this node's shards onto
+                                # remaining replicas (reference
+                                # executor.go:2495-2506).
+                                bad_nodes.add(node_id)
+                                pending.extend(nshards)
+            self._partition_log.append(decision)
             if not partials:
                 partials = [self.local._execute_call(idx, call, [])]
             return partials
+
+    # -- cluster-on-mesh collective dispatch --------------------------------
+
+    def _mesh_on(self) -> bool:
+        return self.mesh_enabled and meshplace.enabled()
+
+    def _mesh_owner_handles(self, groups: dict) -> dict:
+        """Pop every owner group whose node is registered as mesh-local;
+        returns node id -> (placement handle, shards).  What remains in
+        ``groups`` is the off-mesh HTTP remainder."""
+        placement = meshplace.default_placement()
+        out = {}
+        for node_id in list(groups):
+            h = placement.handle(node_id)
+            if h is not None:
+                out[node_id] = (h, groups.pop(node_id))
+        return out
+
+    def _mesh_executor_for(self, owners: dict) -> Executor:
+        """Facade executor for one shard assignment (node id ->
+        (holder, generation, shards)); cached so repeated queries over a
+        stable assignment keep their device stacks warm."""
+        key = tuple(sorted(
+            (nid, gen, tuple(sorted(sh)))
+            for nid, (holder, gen, sh) in owners.items()
+        ))
+        with self._mesh_cache_lock:
+            hit = self._mesh_cache.get(key)
+            if hit is not None:
+                self._mesh_cache.move_to_end(key)
+                return hit
+            view = MeshHolderView(
+                self.holder,
+                {
+                    nid: (holder, tuple(sorted(sh)))
+                    for nid, (holder, gen, sh) in owners.items()
+                },
+            )
+            ex = Executor(view, translator=self.local.translator)
+            self._mesh_cache[key] = ex
+            while len(self._mesh_cache) > self._MESH_CACHE_ENTRIES:
+                self._mesh_cache.popitem(last=False)
+            return ex
+
+    def _mesh_execute(
+        self, index_name: str, call: Call, mesh_groups: dict,
+        local_shards: list[int] | None,
+    ) -> Any:
+        """Answer every mesh-local owner group (plus the coordinator's
+        own shards, folded in) as ONE jit-sharded launch over the holder
+        facade — inline on the request thread, same invariant as the
+        plain local group."""
+        owners = {
+            nid: (h.holder, h.generation, nshards)
+            for nid, (h, nshards) in mesh_groups.items()
+        }
+        if local_shards:
+            # generation 0: the coordinator's holder identity is tied to
+            # this executor's lifetime, not a registry entry
+            owners[self.cluster.node_id] = (self.holder, 0, local_shards)
+        shards = sorted(s for _, _, sh in owners.values() for s in sh)
+        ex = self._mesh_executor_for(owners)
+        fidx = ex.holder.index(index_name)
+        if fidx is None:
+            raise IndexNotFoundError(f"index not found: {index_name}")
+        span = tracing.start_span("dist.meshDispatch")
+        span.set_tag("call", call.name).set_tag("nodes", len(owners))
+        span.set_tag("shards", len(shards))
+        with span, qprofile.span(
+            "meshDispatch", nodes=len(owners), shards=len(shards)
+        ):
+            out = ex._execute_call(fidx, call, shards)
+        self.mesh_dispatches += 1
+        self.holder.stats.count("dist_mesh_local_total", 1)
+        return out
+
+    def mesh_complete(
+        self,
+        index_name: str,
+        query: pql.Query,
+        shards: list[int] | None = None,
+    ) -> bool:
+        """True when every owner of the query's shards is a slice of the
+        local mesh — such a read can ride the continuous-batching plane
+        (server/batcher.py) because it dispatches as one sharded launch
+        with no HTTP subrequests to wait on."""
+        if self._single or not self._mesh_on():
+            return False
+        if query.write_calls():
+            return False
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return False
+        return self._plan_mesh_batch(index_name, idx, shards) is not None
+
+    def _placement_token(self) -> tuple:
+        """Validity fence for cached mesh plans: changes whenever the
+        shard->owner mapping can change — membership (node ids), resize
+        epoch, or per-shard flip progress mid-resize."""
+        cl = self.cluster
+        flips = len(cl.flipped) if cl.pending_nodes is not None else -1
+        return (cl.epoch, flips, tuple(n.id for n in cl.nodes))
+
+    def _plan_mesh_batch(self, index_name: str, idx, shards: list[int] | None):
+        """Partition one batched query; returns (assignment key, owners,
+        shard list) when the whole query is mesh-resolvable, else None.
+
+        The owner grouping is cached per (index, shard set) under a
+        placement token — grouping hashes every shard through the ring
+        per call, which would otherwise dominate the mesh hot path.  A
+        cache hit still re-resolves every peer's registry handle, so a
+        withdrawn/restarted node invalidates the plan immediately."""
+        try:
+            shard_list = self.local._shards_for(idx, shards)
+        except ExecuteError:
+            return None
+        token = self._placement_token()
+        ckey = (index_name, tuple(shard_list))
+        placement = meshplace.default_placement()
+        with self._plan_cache_lock:
+            hit = self._plan_cache.get(ckey)
+            if hit is not None:
+                self._plan_cache.move_to_end(ckey)
+        if hit is not None and hit[0] == token:
+            owners = {}
+            for nid, nshards in hit[1].items():
+                if nid == self.cluster.node_id:
+                    owners[nid] = (self.holder, 0, nshards)
+                    continue
+                h = placement.handle(nid)
+                if h is None:
+                    owners = None  # peer left the mesh; replan below
+                    break
+                owners[nid] = (h.holder, h.generation, nshards)
+            if owners:
+                key = tuple(sorted(
+                    (nid, gen, tuple(sorted(sh)))
+                    for nid, (holder, gen, sh) in owners.items()
+                ))
+                return key, owners, shard_list
+        try:
+            groups = self._group_by_live_owner(index_name, shard_list, set())
+        except ExecuteError:
+            return None
+        local = groups.pop(self.cluster.node_id, None)
+        owners = {}
+        for nid, nshards in groups.items():
+            h = placement.handle(nid)
+            if h is None:
+                return None
+            owners[nid] = (h.holder, h.generation, nshards)
+        if local:
+            owners[self.cluster.node_id] = (self.holder, 0, local)
+        if not owners:
+            return None
+        with self._plan_cache_lock:
+            self._plan_cache[ckey] = (
+                token,
+                {
+                    nid: tuple(sorted(sh))
+                    for nid, (holder, gen, sh) in owners.items()
+                },
+            )
+            while len(self._plan_cache) > self._PLAN_CACHE_ENTRIES:
+                self._plan_cache.popitem(last=False)
+        key = tuple(sorted(
+            (nid, gen, tuple(sorted(sh)))
+            for nid, (holder, gen, sh) in owners.items()
+        ))
+        return key, owners, shard_list
+
+    def execute_batch(
+        self, index_name: str, queries: list[tuple]
+    ) -> list[Any]:
+        """Cross-request micro-batch entry (server/batcher.py): queries
+        whose shard owners all resolve to the local mesh dispatch as one
+        sharded ``Executor.execute_batch`` launch per assignment, demuxed
+        per query; everything else (off-mesh owners, writes, planning
+        failures) falls back to the per-query distributed path.  Result
+        slots mirror ``Executor.execute_batch``: a list of per-call
+        results, or an Exception instance for that query alone."""
+        if self._single:
+            return self.local.execute_batch(index_name, queries)
+        idx = self.holder.index(index_name)
+        if idx is None:
+            err = IndexNotFoundError(f"index not found: {index_name}")
+            return [err for _ in queries]
+        out: list[Any] = [None] * len(queries)
+        fallback: list[tuple] = []  # (slot, query, shards)
+        flights: dict[tuple, list] = {}  # assignment key -> [(slot, q, shard_list)]
+        plans: dict[tuple, dict] = {}  # assignment key -> owners
+        span = tracing.start_span("executor.ExecuteBatch")
+        span.set_tag("index", index_name).set_tag("queries", len(queries))
+        with span:
+            for slot, (query, qshards) in enumerate(queries):
+                try:
+                    q = pql.parse(query) if isinstance(query, str) else query
+                except Exception as e:  # parse errors belong to their slot
+                    out[slot] = e
+                    continue
+                plan = None
+                if self._mesh_on() and not q.write_calls():
+                    plan = self._plan_mesh_batch(index_name, idx, qshards)
+                if plan is None:
+                    fallback.append((slot, q, qshards))
+                    continue
+                key, owners, shard_list = plan
+                plans[key] = owners
+                flights.setdefault(key, []).append((slot, q, shard_list))
+            for key, items in flights.items():
+                try:
+                    ex = self._mesh_executor_for(plans[key])
+                    mspan = tracing.start_span("dist.meshDispatch")
+                    mspan.set_tag("queries", len(items))
+                    with mspan, qprofile.span(
+                        "meshDispatch", queries=len(items)
+                    ):
+                        got = ex.execute_batch(
+                            index_name,
+                            [(q, list(sh)) for _, q, sh in items],
+                        )
+                    for (slot, _, _), res in zip(items, got):
+                        out[slot] = res
+                    self.mesh_dispatches += 1
+                    self.holder.stats.count(
+                        "dist_mesh_local_total", len(items)
+                    )
+                    self._partition_log.append({
+                        "call": "<batch>", "index": index_name,
+                        "queries": len(items),
+                        "meshNodes": len(plans[key]),
+                        "meshShards": sum(
+                            len(sh) for _, _, sh in plans[key].values()
+                        ),
+                        "httpNodes": 0, "httpShards": 0, "localShards": 0,
+                        "meshFallback": False,
+                    })
+                except Exception:
+                    # Same fallback ladder as _map_partials: a mesh
+                    # failure demotes this flight to per-query HTTP.
+                    logger.exception(
+                        "mesh batch dispatch failed on %r; "
+                        "re-running %d queries individually",
+                        index_name, len(items),
+                    )
+                    self.holder.stats.count("dist_mesh_fallback_total", 1)
+                    self.mesh_fallbacks += 1
+                    fallback.extend(
+                        (slot, q, list(sh)) for slot, q, sh in items
+                    )
+            for slot, q, qshards in fallback:
+                try:
+                    out[slot] = self.execute(index_name, q, shards=qshards)
+                except Exception as e:  # isolate per query, like Executor
+                    out[slot] = e
+        return out
+
+    def snapshot(self) -> dict:
+        """/debug/vars ``dist`` block: placement map plus recent per-call
+        partition decisions (docs/serving.md "Cluster on the mesh")."""
+        return {
+            "meshEnabled": self._mesh_on(),
+            "singleNode": self._single,
+            "placement": meshplace.default_placement().snapshot(),
+            "meshDispatches": self.mesh_dispatches,
+            "meshFallbacks": self.mesh_fallbacks,
+            "recentPartitions": list(self._partition_log),
+        }
 
     def _query_remote(
         self,

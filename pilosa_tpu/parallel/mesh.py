@@ -17,7 +17,13 @@ row-block all-gather into every pair/gram kernel.  Splitting rows only
 pays when shards < devices (a tiny index on a large pod), which the
 stacked layout handles anyway by padding the shard axis.  The axis name
 is kept in ``default_mesh`` signatures (size 1) so ShardedField's
-specs stay stable."""
+specs stay stable.
+
+The CLUSTER layer rides this same mesh: nodes whose holders live in
+this process register in ``parallel/meshplace.py``, and
+``cluster/dist.py`` then plans their shard groups into one jit-sharded
+launch over ``serving_mesh()`` instead of an HTTP relay — the cluster
+disappears into the mesh (docs/serving.md "Cluster on the mesh")."""
 
 from __future__ import annotations
 

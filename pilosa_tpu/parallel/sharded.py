@@ -17,7 +17,12 @@ Shard axis 0 is the reference's shard→node placement made static; row axis
 
 The single-node executor (exec/executor.py) uses per-fragment dicts for
 flexibility; this stacked path is the high-throughput lane used by the
-benchmark and the distributed query planner.
+benchmark and the distributed query planner.  The cluster layer reaches
+the same stacked lane for PEER-owned shards too: a mesh-local partition
+(cluster/dist.py + cluster/meshexec.py) folds in-process owner nodes'
+fragments into the executor's ``[S, R, W]`` stacks, so a distributed
+query over mesh-resident shards is one of these launches, not an HTTP
+fan-out.
 """
 
 from __future__ import annotations

@@ -140,13 +140,17 @@ class API:
         # Continuous-batching serving plane (server/batcher.py):
         # concurrent read-only queries coalesce into micro-batched
         # executor dispatches.  ``batch_window<=0`` or ``batch_max_size
-        # <=1`` disables it — every query takes the direct path.
+        # <=1`` disables it — every query takes the direct path.  On a
+        # clustered node the plane wraps the DISTRIBUTED executor, whose
+        # execute/execute_batch collapse to the local executor for
+        # single-node clusters and dispatch mesh-complete flights as one
+        # sharded launch (cluster/dist.py execute_batch).
         from pilosa_tpu.server.batcher import QueryBatcher
 
         self.batcher = None
         if batch_window > 0 and batch_max_size > 1:
             self.batcher = QueryBatcher(
-                self.executor,
+                self.dist if self.dist is not None else self.executor,
                 stats=self.holder.stats,
                 window=batch_window,
                 max_batch=batch_max_size,
@@ -270,12 +274,15 @@ class API:
         return resp
 
     def _execute_query(self, index: str, pql_text: str, shards):
-        """Route one local query: read-only queries on a single node
-        ride the continuous-batching plane (``batcher.submit`` parks
-        this handler thread until its micro-batch lands); writes and
-        true multi-node fan-outs keep the direct path — writes for
-        strict in-order semantics, fan-outs because the distributed
-        executor batches per-hop itself (ROADMAP item 4)."""
+        """Route one local query: read-only queries ride the
+        continuous-batching plane (``batcher.submit`` parks this handler
+        thread until its micro-batch lands) when they resolve entirely
+        on this node OR onto the local serving mesh — a mesh-complete
+        flight dispatches as ONE sharded launch (cluster/dist.py
+        execute_batch) instead of N HTTP subrequests.  Writes and
+        fan-outs with off-mesh owners keep the direct path — writes for
+        strict in-order semantics, off-mesh fan-outs because the
+        distributed executor batches per-hop itself (ROADMAP item 4)."""
         from pilosa_tpu import pql
 
         q = pql.parse(pql_text) if isinstance(pql_text, str) else pql_text
@@ -283,11 +290,16 @@ class API:
         # point (this thread handles the whole request).
         slo.note_class(slo.classify_query(q))
         batcher = self.batcher
-        single = self.dist is None or self.dist._single
-        if batcher is not None and single and batcher.accepts(q):
-            return batcher.submit(index, q, shards=shards)
-        if self.dist is not None:
-            return self.dist.execute(index, q, shards=shards)
+        dist = self.dist
+        if batcher is not None and batcher.accepts(q):
+            if (
+                dist is None
+                or dist._single
+                or dist.mesh_complete(index, q, shards)
+            ):
+                return batcher.submit(index, q, shards=shards)
+        if dist is not None:
+            return dist.execute(index, q, shards=shards)
         return self.executor.execute(index, q, shards=shards)
 
     # -- schema CRUD (reference api.go:161-495) -----------------------------

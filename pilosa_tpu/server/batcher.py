@@ -46,8 +46,13 @@ bypasses/expiries) and per-request ``?profile=true`` attribution — a
 grafted as a sub-profile (kernel records for the batched launch).
 
 Write-bearing queries never enter the plane (strict in-order semantics
-stay on the per-request path), and multi-node clusters bypass it — the
-distributed fan-out has its own batching story (ROADMAP item 4).
+stay on the per-request path).  On a clustered node the plane fronts the
+DISTRIBUTED executor: queries whose shard owners all resolve onto the
+local serving mesh (cluster/dist.py mesh_complete) are admitted and a
+flight of them dispatches as ONE jit-sharded launch via
+``DistributedExecutor.execute_batch``; fan-outs with off-mesh owners
+keep the direct path — that leg has its own per-hop batching story
+(ROADMAP item 4).
 """
 
 from __future__ import annotations

@@ -349,6 +349,11 @@ class Handler(BaseHTTPRequestHandler):
             # source-side migration sessions: per-fragment pending
             # delta ops = live catch-up lag during an online resize
             snap["migrations"] = migrations.snapshot_summary()
+        dist = getattr(self.api, "dist", None)
+        if dist is not None:
+            # cluster-on-mesh routing: the placement map plus recent
+            # per-call partition decisions (mesh vs HTTP vs local)
+            snap["dist"] = dist.snapshot()
         self._send_json(200, snap)
 
     def r_debug_slo(self):

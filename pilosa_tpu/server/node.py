@@ -186,9 +186,17 @@ class NodeServer:
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
         resize_watchdog_deadline: float = 15.0,
+        mesh_dispatch: bool = True,
     ):
         self.host = host
         self.tls = bool(tls_cert)
+        # Cluster-on-mesh: advertise this node's holder in the process
+        # placement map on start() so in-process peers (one process per
+        # mesh) answer our shards with a jit-sharded launch instead of an
+        # HTTP relay; see parallel/meshplace.py and docs/serving.md.
+        # False keeps the node off the mesh in BOTH directions: it never
+        # registers, and its own fan-outs stay on the HTTP relay.
+        self.mesh_dispatch = mesh_dispatch
         self.holder = Holder(n_words)
         # Metrics backend; MemStatsClient serves /metrics + /debug/vars
         # (reference server.go:397-411 metric.service selection).
@@ -411,6 +419,12 @@ class NodeServer:
     def start(self) -> None:
         self.server.serve_background()
         self.cluster.local_node.uri = self.uri
+        from pilosa_tpu.parallel import meshplace
+
+        if self.mesh_dispatch and meshplace.enabled():
+            meshplace.default_placement().register(self.node_id, self.holder)
+        elif self.api.dist is not None:
+            self.api.dist.mesh_enabled = False
         self.runtime_monitor.start()
         if self.flightrec is not None:
             self.flightrec.start()
@@ -534,6 +548,11 @@ class NodeServer:
         return self.membership
 
     def stop(self) -> None:
+        from pilosa_tpu.parallel import meshplace
+
+        # Withdraw from the placement map FIRST: peers must stop
+        # resolving our fragments before the holder starts tearing down.
+        meshplace.default_placement().unregister(self.node_id)
         if self._ae_loop is not None:
             # the loop reference is kept even if a slow pass outlives the
             # join timeout, so a restart can't spawn a second loop while

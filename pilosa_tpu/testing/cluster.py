@@ -43,11 +43,16 @@ class InProcessCluster:
         flightrec_sample_interval: float = 0.025,
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
+        mesh_dispatch: bool = True,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
         self._slow_query_time = slow_query_time
         self._ingest_knobs = {
+            # In-process nodes share one device mesh, so cluster-on-mesh
+            # dispatch (cluster/dist.py) is exercised by default; tests
+            # that assert on the HTTP fan-out plane pass False.
+            "mesh_dispatch": mesh_dispatch,
             "import_workers": import_workers,
             "import_queue_depth": import_queue_depth,
             "ingest_staging_buffers": ingest_staging_buffers,

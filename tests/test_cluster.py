@@ -457,7 +457,9 @@ def test_parallel_node_fanout():
     executor.go:2520-2573)."""
     import time
 
-    with InProcessCluster(3, replica_n=1) as c:
+    # mesh_dispatch=False: this test measures HTTP fan-out concurrency;
+    # mesh-local dispatch would answer without any remote calls to overlap
+    with InProcessCluster(3, replica_n=1, mesh_dispatch=False) as c:
         c.create_index("pf")
         c.create_field("pf", "f")
         # enough shards that every node owns some

@@ -20,7 +20,10 @@ from pilosa_tpu.testing.cluster import InProcessCluster
 
 @pytest.fixture(scope="module")
 def cluster():
-    with InProcessCluster(3, replica_n=2) as c:
+    # mesh_dispatch=False: this module exercises the HTTP fan-out plane
+    # (pauses, resets, breakers); mesh-local dispatch would answer the
+    # queries without ever touching the faulted transport.
+    with InProcessCluster(3, replica_n=2, mesh_dispatch=False) as c:
         c.create_index("ci")
         c.create_field("ci", "cf")
         width = c.nodes[0].holder.n_words * 32
@@ -98,8 +101,9 @@ def test_data_converges_after_pause_and_writes(cluster):
 @pytest.fixture()
 def chaos_cluster():
     """Fresh per-test cluster: chaos scenarios mutate breaker and fault
-    state, which must not leak between tests."""
-    with InProcessCluster(3, replica_n=2) as c:
+    state, which must not leak between tests.  mesh_dispatch=False keeps
+    every fan-out on the faulted HTTP transport."""
+    with InProcessCluster(3, replica_n=2, mesh_dispatch=False) as c:
         c.create_index("ci")
         c.create_field("ci", "cf")
         width = c.nodes[0].holder.n_words * 32

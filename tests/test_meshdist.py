@@ -1,0 +1,317 @@
+"""Cluster-on-mesh dispatch tests (cluster/dist.py + cluster/meshexec.py
++ parallel/meshplace.py): in-mesh owner groups answer as one jit-sharded
+launch with ZERO HTTP subrequests, bit-for-bit identical to both the
+forced-HTTP relay and a single-node holder; off-mesh peers keep the
+breaker-aware HTTP fan-out; mesh failures demote to HTTP mid-query."""
+
+import contextlib
+import random
+import time
+
+import pytest
+
+from pilosa_tpu.parallel import meshplace
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.testing import InProcessCluster
+
+
+@contextlib.contextmanager
+def _http_counter(cluster):
+    """Count HTTP query subrequests issued by ANY node's cluster client."""
+    calls = []
+    origs = []
+    for n in cluster.nodes:
+        orig = n.client.query_node
+
+        def wrap(*a, _o=orig, **k):
+            calls.append(a)
+            return _o(*a, **k)
+
+        origs.append((n.client, orig))
+        n.client.query_node = wrap
+    try:
+        yield calls
+    finally:
+        for client, orig in origs:
+            client.query_node = orig
+
+
+def _span_names(node, out):
+    out.add(node.get("name"))
+    for c in node.get("children", []):
+        _span_names(c, out)
+    for sp in node.get("subprofiles", []):
+        if sp.get("profile"):
+            _span_names(sp["profile"]["tree"], out)
+    return out
+
+
+def _coord_idx(c):
+    return next(i for i, n in enumerate(c.nodes) if n.node_id == c.coordinator_id)
+
+
+# -- zero-HTTP collective dispatch -------------------------------------------
+
+
+def test_eight_way_count_topn_zero_http():
+    """The acceptance bar: distributed Count/TopN on an in-mesh 8-way
+    cluster dispatch as ONE sharded launch — no HTTP subrequest at all —
+    and the routing counters + profile spans prove which path ran."""
+    with InProcessCluster(8, replica_n=1) as c:
+        c.create_index("m8")
+        c.create_field("m8", "f")
+        bits = [(r, s * SHARD_WIDTH + 3 * r + 1) for s in range(16) for r in range(3)]
+        c.import_bits("m8", "f", bits)
+        qi = _coord_idx(c)
+        stats = c.nodes[qi].holder.stats
+        # warm the jit caches so the timed section measures dispatch, not
+        # first-launch compilation
+        c.query(qi, "m8", "Count(Row(f=0))")
+        c.query(qi, "m8", "TopN(f, n=2)")
+        before = stats.get_counter("dist_mesh_local_total")
+        # saturate the fan-out pool: mesh + local groups must run inline
+        # on the request thread, never queued behind slow HTTP legs
+        pool = c.nodes[qi].api.dist._fanout_pool()
+        blockers = [pool.submit(time.sleep, 2.0) for _ in range(8)]
+        with _http_counter(c) as calls:
+            t0 = time.monotonic()
+            r1 = c.query(qi, "m8", "Count(Row(f=1))", profile=True)
+            r2 = c.query(qi, "m8", "TopN(f, n=2)")
+            wall = time.monotonic() - t0
+        for b in blockers:
+            b.cancel()
+        assert r1["results"][0] == 16
+        top = [(p["id"], p["count"]) for p in r2["results"][0]]
+        assert sorted(n for _, n in top) == [16, 16]
+        assert calls == [], f"mesh dispatch leaked HTTP subrequests: {calls}"
+        assert wall < 1.9, f"dispatch waited on the saturated pool: {wall:.2f}s"
+        assert stats.get_counter("dist_mesh_local_total") > before
+        names = _span_names(r1["profile"]["tree"], set())
+        assert "meshDispatch" in names, names
+        assert "dist.fanout" not in names and "dist.httpFanout" not in names
+        snap = c.nodes[qi].api.dist.snapshot()
+        assert snap["meshEnabled"] and snap["meshDispatches"] >= 1
+        assert {n.node_id for n in c.nodes} <= set(snap["placement"])
+        assert snap["recentPartitions"], "partition decisions not logged"
+
+
+# -- three-way parity --------------------------------------------------------
+
+
+QUERIES = [
+    "Count(Row(f=1))",
+    "Count(Union(Row(f=0), Row(f=2)))",
+    "TopN(f, n=3)",
+    "GroupBy(Rows(f))",
+    "Count(Row(v > 400))",
+    "Sum(field=v)",
+    "Min(field=v)",
+]
+
+
+def _seed_random(target, rng):
+    target.create_index("p")
+    target.create_field("p", "f")
+    target.create_field("p", "v", {"type": "int", "min": 0, "max": 1000})
+    cols = sorted(rng.sample(range(SHARD_WIDTH * 6), 300))
+    bits = [(rng.randrange(4), col) for col in cols]
+    target.import_bits("p", "f", bits)
+    vcols = cols[::2]
+    target.import_values("p", "v", vcols, [(col * 7) % 997 for col in vcols])
+
+
+def test_randomized_three_way_parity():
+    """Randomized Count/TopN/GroupBy/Range/Sum answered three ways —
+    single-node, forced-HTTP relay, mesh-local collective — must agree
+    bit for bit (same reducers, different transport)."""
+    with InProcessCluster(3, replica_n=1) as c:
+        _seed_random(c, random.Random(20260805))
+        # querier must have at least one REMOTE-owned shard, or the
+        # forced-HTTP phase would trivially stay local (placement can
+        # park a small index entirely on one node)
+        qi = next(
+            i
+            for i in range(len(c.nodes))
+            if any(c.owner_of("p", s) is not c.nodes[i] for s in range(6))
+        )
+        with _http_counter(c) as calls:
+            mesh = [c.query(qi, "p", q)["results"] for q in QUERIES]
+        assert calls == [], "parity baseline was not mesh-dispatched"
+        for n in c.nodes:
+            n.api.dist.mesh_enabled = False
+        with _http_counter(c) as calls:
+            http = [c.query(qi, "p", q)["results"] for q in QUERIES]
+        assert calls, "forced-HTTP leg never left the node"
+    with InProcessCluster(1) as single:
+        _seed_random(single, random.Random(20260805))
+        solo = [single.query(0, "p", q)["results"] for q in QUERIES]
+    for q, m, h, s in zip(QUERIES, mesh, http, solo):
+        assert m == h, f"mesh != http for {q}: {m} vs {h}"
+        assert m == s, f"mesh != single-node for {q}: {m} vs {s}"
+
+
+# -- mixed partition: mesh + off-mesh HTTP remainder -------------------------
+
+
+def test_mixed_partition_mesh_plus_http():
+    """An owner withdrawn from the placement map (off-mesh peer) keeps
+    its shards on the HTTP relay while the rest of the query rides the
+    mesh — one query, both transports, merged by the same reducers."""
+    with InProcessCluster(3, replica_n=1) as c:
+        c.create_index("mx")
+        c.create_field("mx", "f")
+        c.import_bits("mx", "f", [(0, s * SHARD_WIDTH + 1) for s in range(12)])
+        # need TWO distinct remote owners: one withdrawn from the mesh
+        # (the HTTP remainder) and one still registered (the mesh part) —
+        # so pick a querier with two other nodes owning shards
+        owner_idx = {c.nodes.index(c.owner_of("mx", s)) for s in range(12)}
+        qi = next(
+            i for i in range(len(c.nodes)) if len(owner_idx - {i}) >= 2
+        )
+        victim = c.nodes[sorted(owner_idx - {qi})[0]]
+        meshplace.default_placement().unregister(victim.node_id)
+        stats = c.nodes[qi].holder.stats
+        mesh_before = stats.get_counter("dist_mesh_local_total")
+        http_before = stats.get_counter(
+            "dist_http_fanout_total", ("reason:off_mesh",)
+        )
+        with _http_counter(c) as calls:
+            res = c.query(qi, "mx", "Count(Row(f=0))")
+        assert res["results"][0] == 12
+        assert calls, "off-mesh owner was not relayed over HTTP"
+        assert all(victim.uri in str(a) for a in calls), calls
+        assert stats.get_counter("dist_mesh_local_total") > mesh_before
+        assert (
+            stats.get_counter("dist_http_fanout_total", ("reason:off_mesh",))
+            > http_before
+        )
+        part = c.nodes[qi].api.dist.snapshot()["recentPartitions"][-1]
+        assert part["meshShards"] >= 1 and part["httpShards"] >= 1, part
+
+
+def test_off_mesh_peer_keeps_breaker_failover():
+    """The fallback ladder bottoms out intact: an off-mesh peer whose
+    transport is faulted still fails over to the surviving replica
+    (which may itself answer via the mesh)."""
+    with InProcessCluster(3, replica_n=2) as c:
+        c.create_index("bf")
+        c.create_field("bf", "f")
+        c.import_bits("bf", "f", [(0, s * SHARD_WIDTH + 1) for s in range(10)])
+        qi = _coord_idx(c)
+        victim = next(
+            (
+                c.owner_of("bf", s)
+                for s in range(10)
+                if c.owner_of("bf", s) is not c.nodes[qi]
+            ),
+            next(n for n in c.nodes if n.node_id != c.coordinator_id),
+        )
+        vi = c.nodes.index(victim)
+        meshplace.default_placement().unregister(victim.node_id)
+        c.inject_fault("reset", node=vi, route="/index/*")
+        # repeated queries: first passes may eat the reset and re-map;
+        # once the breaker opens, routing steers around the peer upfront
+        for _ in range(4):
+            assert c.query(qi, "bf", "Count(Row(f=0))")["results"][0] == 10
+        dist = c.nodes[qi].api.dist
+        assert dist.snapshot()["meshEnabled"] is True
+
+
+# -- fallback ladder: mesh error demotes to HTTP -----------------------------
+
+
+def test_mesh_error_demotes_query_to_http():
+    """A collective-path failure never fails a query the HTTP relay can
+    still answer: the flight demotes mid-query and the fallback counter
+    records the evidence."""
+    with InProcessCluster(3, replica_n=1) as c:
+        c.create_index("fb")
+        c.create_field("fb", "f")
+        c.import_bits("fb", "f", [(0, s * SHARD_WIDTH + 1) for s in range(9)])
+        # querier with at least one remote-owned shard: the demoted query
+        # must really produce HTTP legs, not collapse to local-only
+        qi = next(
+            i
+            for i in range(len(c.nodes))
+            if any(c.owner_of("fb", s) is not c.nodes[i] for s in range(9))
+        )
+        dist = c.nodes[qi].api.dist
+        stats = c.nodes[qi].holder.stats
+
+        def boom(owners):
+            raise RuntimeError("injected mesh failure")
+
+        orig = dist._mesh_executor_for
+        dist._mesh_executor_for = boom
+        try:
+            with _http_counter(c) as calls:
+                res = c.query(qi, "fb", "Count(Row(f=0))")
+        finally:
+            dist._mesh_executor_for = orig
+        assert res["results"][0] == 9
+        assert calls, "demoted query never reached the HTTP relay"
+        assert dist.mesh_fallbacks >= 1
+        assert stats.get_counter("dist_mesh_fallback_total") >= 1
+        assert (
+            stats.get_counter("dist_http_fanout_total", ("reason:mesh_error",))
+            >= 1
+        )
+        parts = dist.snapshot()["recentPartitions"]
+        assert any(p.get("meshFallback") for p in parts), parts
+        # the ladder is per-query: the next query rides the mesh again
+        with _http_counter(c) as calls:
+            assert c.query(qi, "fb", "Count(Row(f=0))")["results"][0] == 9
+        assert calls == []
+
+
+# -- local-inline invariant (regression) -------------------------------------
+
+
+def test_local_shards_inline_when_pool_saturated():
+    """Purely-local shard groups must run on the request thread even
+    with the HTTP fan-out plane selected and its worker pool saturated —
+    local work never queues behind slow remote sockets."""
+    with InProcessCluster(2, replica_n=1, mesh_dispatch=False) as c:
+        c.create_index("li")
+        c.create_field("li", "f")
+        # bits only in shards the querier owns -> no remote group at all
+        local_shards = [s for s in range(32) if c.owner_of("li", s) is c.nodes[0]]
+        assert len(local_shards) >= 2
+        c.import_bits(
+            "li", "f", [(0, s * SHARD_WIDTH + 5) for s in local_shards[:3]]
+        )
+        c.query(0, "li", "Count(Row(f=0))")  # warm jit caches
+        pool = c.nodes[0].api.dist._fanout_pool()
+        blockers = [pool.submit(time.sleep, 2.0) for _ in range(8)]
+        t0 = time.monotonic()
+        res = c.query(0, "li", "Count(Row(f=0))")
+        wall = time.monotonic() - t0
+        for b in blockers:
+            b.cancel()
+        assert res["results"][0] == len(local_shards[:3])
+        assert wall < 1.9, f"local group queued behind the pool: {wall:.2f}s"
+
+
+# -- kill switch -------------------------------------------------------------
+
+
+def test_env_kill_switch_forces_http(monkeypatch):
+    monkeypatch.setenv("PILOSA_MESH_DISPATCH", "0")
+    assert not meshplace.enabled()
+    with InProcessCluster(2, replica_n=1) as c:
+        c.create_index("ks")
+        c.create_field("ks", "f")
+        c.import_bits("ks", "f", [(0, s * SHARD_WIDTH + 1) for s in range(8)])
+        qi = next(
+            i
+            for i in range(len(c.nodes))
+            if any(c.owner_of("ks", s) is not c.nodes[i] for s in range(8))
+        )
+        with _http_counter(c) as calls:
+            assert c.query(qi, "ks", "Count(Row(f=0))")["results"][0] == 8
+        assert calls, "kill switch did not force the HTTP relay"
+        stats = c.nodes[qi].holder.stats
+        assert (
+            stats.get_counter("dist_http_fanout_total", ("reason:disabled",))
+            >= 1
+        )

@@ -146,7 +146,9 @@ def _remote_shard(cl, index):
 
 
 def test_distributed_profile_merges_remote_subprofiles():
-    with InProcessCluster(2) as cl:
+    # mesh_dispatch=False: this test asserts the REMOTE node's sub-profile
+    # comes back over the HTTP relay; mesh dispatch profiles locally
+    with InProcessCluster(2, mesh_dispatch=False) as cl:
         cl.create_index("i")
         cl.create_field("i", "f")
         rs = _remote_shard(cl, "i")
@@ -187,7 +189,9 @@ def test_unprofiled_query_has_no_profile_key():
 
 
 def test_slow_query_log_captures_faulted_fanout():
-    with InProcessCluster(2, slow_query_time=0.05) as cl:
+    # mesh_dispatch=False: the slowness is injected on the HTTP hop to the
+    # owner; mesh dispatch would bypass the faulted transport entirely
+    with InProcessCluster(2, slow_query_time=0.05, mesh_dispatch=False) as cl:
         cl.create_index("i")
         cl.create_field("i", "f")
         rs = _remote_shard(cl, "i")

@@ -241,12 +241,15 @@ def test_debug_traces_and_exemplars_over_http():
 
 
 def test_cluster_true_assembles_spans_from_every_node():
+    # mesh_dispatch=False: the assertions want a dist.fanout leg plus the
+    # remote node's http.query handler span; mesh dispatch has neither
     with InProcessCluster(
         2,
         slo_objectives={
             "read.count": {"availability": 0.999, "latencyP99Ms": 0.001}
         },
         trace_baseline_n=0,
+        mesh_dispatch=False,
     ) as c:
         _seed(c)  # shard 0 only
         owner = c.owner_of("ti", 0)
@@ -276,12 +279,15 @@ def test_cluster_true_assembles_spans_from_every_node():
 
 
 def test_slo_burn_under_injected_faults_captures_one_incident():
+    # mesh_dispatch=False: the burn is driven by faulted HTTP legs to the
+    # owner; mesh dispatch would answer locally and never hit the fault
     with InProcessCluster(
         2,
         slo_burn_rules=FAST_RULE_SPECS,
         slo_slot_seconds=1.0,
         flightrec_segment_seconds=0.1,
         trace_baseline_n=0,
+        mesh_dispatch=False,
     ) as c:
         _seed(c)
         owner = c.owner_of("ti", 0)

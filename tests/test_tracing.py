@@ -84,7 +84,9 @@ def test_cross_node_trace_joins(recorder):
     from pilosa_tpu.shardwidth import SHARD_WIDTH
     from pilosa_tpu.testing import InProcessCluster
 
-    with InProcessCluster(2) as c:
+    # this test is ABOUT the HTTP relay's header propagation; mesh-local
+    # dispatch would answer in-process with no hop to join
+    with InProcessCluster(2, mesh_dispatch=False) as c:
         c.create_index("tr")
         c.create_field("tr", "f")
         c.import_bits("tr", "f", [(1, 3)])  # shard 0 only
