@@ -543,6 +543,130 @@ def _mesh_dist_lane() -> dict:
     }
 
 
+def _residency_lane() -> dict:
+    """Tiered-residency lane: the SAME zipfian stack workload through the
+    in-process batched API twice — fully resident (uncapped budget; the
+    prefetcher no-ops by design) vs an HBM budget sized to hold ~1/6 of
+    the field stacks (6x oversubscribed), where the flight-driven
+    prefetcher (server/prefetch.py) must keep the zipfian head resident
+    and stage the warm tail ahead of its flights.  Acceptance bars
+    (docs/residency.md): oversubscribed qps >= 25%% of fully resident,
+    and prefetch_useful/prefetch_issued >= 0.5.
+
+    Queries are ``Count(Intersect(Row, Row))`` trees — the shape the
+    batched dispatch compiles over field stacks (exec/astbatch.py; bare
+    ``Count(Row)`` rides the host segment path and never touches HBM
+    residency).  Concurrency comes from in-process threads: the lane
+    measures the residency tier, not the HTTP listener (that is the
+    served sweep's job)."""
+    import random as _random
+    import threading as _threading
+
+    from pilosa_tpu.core import membudget, residency
+    from pilosa_tpu.server.api import API
+
+    # 36 fields at 1/6 cap = 6 resident stacks: oversubscription is an
+    # INDEX-level property, while a single flight's working set (~8
+    # concurrent callers, zipfian) must still be coverable or the flight
+    # self-thrashes before any policy can help
+    n_fields = 36
+    n_threads = 8
+    per_thread = 40
+    rounds = 3
+    weights = [1.0 / (fi + 1) ** 1.3 for fi in range(n_fields)]
+
+    def run_phase(cap_of_total):
+        api = API(batch_window=0.004, batch_max_size=64)
+        try:
+            api.create_index("ri")
+            rng = np.random.default_rng(31)
+            width = api.holder.n_words * 32
+            for fi in range(n_fields):
+                api.create_field("ri", f"f{fi}")
+                writes = [
+                    f"Set({int(c)}, f{fi}={row})"
+                    for row in (3, 4)
+                    for c in rng.integers(0, width, size=64)
+                ]
+                api.query("ri", " ".join(writes))
+            stack_bytes = 2 * api.holder.n_words * 4  # S=1, R=2 rows
+            total = n_fields * stack_bytes
+            cap = None if cap_of_total is None else max(
+                stack_bytes, int(total * cap_of_total)
+            )
+            membudget.configure(cap)
+            residency.configure()
+
+            def worker(seed, out):
+                r = _random.Random(seed)
+                t0 = time.perf_counter()
+                for _ in range(per_thread):
+                    fi = r.choices(range(n_fields), weights=weights)[0]
+                    api.query(
+                        "ri",
+                        f"Count(Intersect(Row(f{fi}=3), Row(f{fi}=4)))",
+                    )
+                out.append(time.perf_counter() - t0)
+
+            best_qps = 0.0
+            for rnd in range(rounds):
+                walls: list = []
+                ts = [
+                    _threading.Thread(target=worker, args=(rnd * 97 + i, walls))
+                    for i in range(n_threads)
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                wall = time.perf_counter() - t0
+                best_qps = max(best_qps, n_threads * per_thread / wall)
+            time.sleep(0.2)  # let trailing prefetch uploads settle
+            return {
+                "qps": best_qps,
+                "cap_bytes": cap,
+                "total_stack_bytes": total,
+                "residency": residency.default_tracker().snapshot(),
+                "budget": membudget.default_budget().snapshot(),
+            }
+        finally:
+            api.close()
+
+    prev_cap = membudget.default_budget().cap
+    try:
+        resident = run_phase(None)
+        oversub = run_phase(1 / 6)
+    finally:
+        membudget.configure(prev_cap)
+        residency.configure()
+    res = oversub["residency"]
+    ratio = (
+        round(oversub["qps"] / resident["qps"], 3) if resident["qps"] else None
+    )
+    useful_frac = res["prefetchUsefulFrac"]
+    return {
+        "resident_qps": round(resident["qps"], 1),
+        "oversubscribed_qps": round(oversub["qps"], 1),
+        "oversubscribed_vs_resident": ratio,
+        "oversubscription_factor": round(
+            oversub["total_stack_bytes"] / oversub["cap_bytes"], 1
+        ),
+        "prefetch_issued": res["prefetchIssued"],
+        "prefetch_useful": res["prefetchUseful"],
+        "prefetch_useful_frac": useful_frac,
+        "device_hit_rate": res["hitRate"],
+        "evictions": oversub["budget"]["evictions"],
+        "auto_pins": oversub["budget"]["pins"],
+        # fully-resident phase must show ZERO prefetch traffic (the
+        # uncapped fast path is what keeps unbudgeted lanes regression-
+        # free)
+        "resident_prefetch_issued": resident["residency"]["prefetchIssued"],
+        "pass_qps_ratio": ratio is not None and ratio >= 0.25,
+        "pass_useful_frac": useful_frac >= 0.5,
+    }
+
+
 def _np_bsi_lt(planes, exists, sign, value, depth):
     """CPU baseline: the same bit-sliced scan in vectorized numpy."""
     lt = np.zeros_like(exists)
@@ -923,6 +1047,15 @@ def main() -> None:
         mesh_dist_lane = _mesh_dist_lane()
     except Exception as e:
         print(f"warning: mesh_dist lane failed: {e}", file=sys.stderr)
+
+    # -- tiered-residency lane: zipfian stack workload fully resident vs
+    # 6x HBM-oversubscribed with flight-driven prefetch (the lane must
+    # never sink the bench)
+    residency_lane = None
+    try:
+        residency_lane = _residency_lane()
+    except Exception as e:
+        print(f"warning: residency lane failed: {e}", file=sys.stderr)
 
     # -- SLO harness lane: a short seeded mixed-workload burst through
     # the full HTTP path with the server's error-budget tracker live
@@ -1437,6 +1570,16 @@ def main() -> None:
         # incident-plane cost: overhead_frac is (1 - on/off); the
         # acceptance bar for the always-on recorder is <= 0.05
         "recorder_overhead": recorder_lane,
+        # tiered-residency lane: oversubscribed_vs_resident >= 0.25 and
+        # prefetch_useful_frac >= 0.5 are the working-set manager's bars
+        # (docs/residency.md)
+        "residency": residency_lane,
+        "residency_oversubscribed_vs_resident": (
+            (residency_lane or {}).get("oversubscribed_vs_resident")
+        ),
+        "residency_prefetch_useful_frac": (
+            (residency_lane or {}).get("prefetch_useful_frac")
+        ),
         "probe": _PROBE_ATTEMPTS,
         "probe_warnings": _PROBE_WARNINGS,
         "forced_cpu": _FORCED_CPU,

@@ -42,7 +42,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from pilosa_tpu.core import membudget
+from pilosa_tpu.core import membudget, residency
 from pilosa_tpu.ops import _hostops, bitops, kernels
 from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WORDS
 
@@ -87,6 +87,7 @@ def _retry_evict(ref) -> None:
             # when the budget already evicted the entry).
             if f._budget_key is not None:
                 membudget.default_budget().release(f._budget_key)
+            residency.default_tracker().note_dropped(f)
 
 
 @jax.jit
@@ -169,6 +170,14 @@ class Fragment:
         # sync (0 when the device copy was already current); the ingest
         # uploader reads this for its overlap accounting
         self.last_sync_h2d_bytes = 0
+        # residency-tier state owned by core/residency.py's tracker:
+        # decayed hit heat, predictive-prefetch flags, and a mirror of
+        # the budget's pin bit (authoritative copy lives in membudget)
+        self._heat = 0.0
+        self._heat_t = 0.0
+        self._res_staging = False  # queued on the prefetch uploader
+        self._res_prefetched = False  # prefetch paid the upload; unqueried
+        self._res_pinned = False
         self._delta_reset()
 
     def _set_host(self, arr: np.ndarray) -> None:
@@ -251,6 +260,7 @@ class Fragment:
         self._delta_reset()
         if self._budget_key is not None:
             membudget.default_budget().release(self._budget_key)
+        residency.default_tracker().note_dropped(self)
 
     # -- mutation -----------------------------------------------------------
 
@@ -785,6 +795,7 @@ class Fragment:
                     # common already-evicted case).
                     if f._budget_key is not None:
                         membudget.default_budget().release(f._budget_key)
+                    residency.default_tracker().note_dropped(f)
                 finally:
                     f._lock.release()
             else:
@@ -817,6 +828,13 @@ class Fragment:
                 self._device = None
                 self._dirty.clear()
                 self._delta_reset()
+            # residency outcome: was the compute copy already there when
+            # this sync started?  (A dirty-row scatter still counts as a
+            # hit — the query didn't pay the cold full upload.)
+            was_resident = (
+                self._device is not None
+                and self._device.shape[0] == self.capacity + 1
+            )
             rebuilt = False
             h2d = 0
             if self._device is None or self._device.shape[0] != self.capacity + 1:
@@ -895,6 +913,9 @@ class Fragment:
             if h2d:
                 kernels.note_transfer(h2d, "h2d")
             self._account_device(rebuilt)
+            # hit/miss + heat feed the pin policy; prefetch-thread syncs
+            # are accounted as prefetch traffic instead (residency.py)
+            residency.default_tracker().note_sync(self, was_resident, h2d)
             return self._device
 
     def row_device(self, row: int) -> jax.Array:

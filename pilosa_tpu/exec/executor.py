@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu import deadline, pql
-from pilosa_tpu.core import membudget, timequantum
+from pilosa_tpu.core import membudget, residency, timequantum
 from pilosa_tpu.obs import qprofile, tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
@@ -378,16 +378,44 @@ class Executor:
                 # the min-stamp entry.  A stamp (vs dict pop/reinsert)
                 # leaves the budget's lock-free _evict pop as the only
                 # writer that removes keys, so no KeyError/resurrection
-                # race between a hit and a concurrent eviction.
+                # race between a hit and a concurrent eviction.  The
+                # budget touch doubles as the clock reference bit — use
+                # stamps, not insertion order, drive its eviction scan —
+                # and a hot enough entry graduates to a budget pin so an
+                # oversubscribed tail can't evict the zipfian head.
                 entry["lru"] = next(self._stack_lru_clock)
+                entry["hits"] = entry.get("hits", 0) + 1
+                tracker = residency.default_tracker()
+                prefetching = tracker.in_prefetch()
                 if entry["versions"] == versions:
                     budget.touch(entry["bkey"])
+                    if prefetching:
+                        # the prefetch thread found it already resident:
+                        # the query (or an earlier prefetch) beat it here
+                        tracker.note_prefetch_wasted()
+                    else:
+                        tracker.note_stack_hit()
+                        tracker.note_hit(entry.get("prefetched", False))
+                        entry["prefetched"] = False
+                        if not entry.get("pinned") and tracker.maybe_pin_stack(
+                            budget, entry["bkey"], entry["hits"]
+                        ):
+                            entry["pinned"] = True
                     return entry["slot_of"], entry["dev"]
                 updated = self._stack_incremental_update(
                     field, entry, frags, shards, versions
                 )
                 if updated is not None:
                     budget.touch(entry["bkey"])
+                    if prefetching:
+                        # a refresh shipped only the drifted shards; the
+                        # NEXT query's hit still credits the prefetch
+                        entry["prefetched"] = True
+                        tracker.note_prefetch_upload(0)
+                    else:
+                        tracker.note_stack_hit()
+                        tracker.note_hit(entry.get("prefetched", False))
+                        entry["prefetched"] = False
                     return updated
                 caches.pop(cache_key, None)
                 budget.release(entry["bkey"])
@@ -463,12 +491,25 @@ class Executor:
             # released whenever the entry is dropped.
             bkey = object()
             weakref.finalize(field, budget.release, bkey)
+            tracker = residency.default_tracker()
+            prefetched = tracker.in_prefetch()
+            if prefetched:
+                # built off the dispatch path by the residency
+                # prefetcher: the first query hit counts it useful
+                tracker.note_prefetch_upload(nbytes)
+            else:
+                tracker.note_miss()
             entry = {
                 "versions": versions,
                 "slot_of": slot_of,
                 "dev": dev,
                 "bkey": bkey,
                 "lru": next(self._stack_lru_clock),
+                # use-stamp hit count feeds the pin policy: a stack this
+                # hot is exempted from budget eviction (residency.py)
+                "hits": 0,
+                "pinned": False,
+                "prefetched": prefetched,
             }
             caches[cache_key] = entry
 
@@ -573,6 +614,23 @@ class Executor:
                 self.gram_cache_hits += 1
                 qprofile.incr("gram_cache_hits")
                 return cached[1], {s: s for s in uniq}
+            # the gram outlives the device stack: a budget-evicted field
+            # re-staged with UNCHANGED fragment versions reattaches its
+            # previous full gram ([R, R] host-tier metadata, tiny) with
+            # zero device work — under oversubscription the bytes churn,
+            # the derived artifacts shouldn't (docs/residency.md)
+            hostg = vars(field).get("_gram_host")
+            if hostg is not None and hostg[0] == (entry.get("versions"), R):
+                g = hostg[1]
+                lock = vars(field).setdefault(
+                    "_stack_lock", threading.RLock()
+                )
+                with lock:
+                    if entry.get("dev") is bits:
+                        entry["gram"] = (bits, g)
+                self.gram_cache_hits += 1
+                qprofile.incr("gram_cache_hits")
+                return g, {s: s for s in uniq}
             if (
                 2 * len(uniq) >= R
                 or entry.get("gram_misses", 0) >= self._GRAM_CACHE_MIN_REUSE
@@ -585,6 +643,9 @@ class Executor:
                     with lock:
                         if entry.get("dev") is bits:  # snapshot current
                             entry["gram"] = (bits, g)
+                            field._gram_host = (
+                                (entry.get("versions"), R), g,
+                            )
                     return g, {s: s for s in uniq}
             else:
                 # under the stack lock: _refresh pops entries under the
@@ -931,6 +992,55 @@ class Executor:
         if not caches:
             return False
         return self._stack_key(shard_list, view_name, n_fixed_rows) in caches
+
+    def prefetch_stack(
+        self,
+        field: Field,
+        shard_list: list[int],
+        view_name: str = VIEW_STANDARD,
+    ) -> None:
+        """Build (or refresh) the field's serving stack off the dispatch
+        path — the residency prefetcher's target (server/prefetch.py).
+        Runs on the uploader thread inside the tracker's prefetch
+        context, so _field_stack books the transfer as prefetch traffic
+        rather than a query miss; a stack the budget declines is simply
+        not built (the dispatch falls back exactly as before).
+
+        The derived serving artifacts ride along: a re-staged stack's
+        pair-count gram is recomputed here too (same cache + snapshot
+        discipline as _field_gram), so an evicted-then-prefetched field
+        serves its next flight from the host gram with zero device work
+        instead of paying the gram launch inside the dispatch."""
+        st = self._field_stack(field, shard_list, view_name)
+        if st is None:
+            return
+        _, bits = st
+        R = bits.shape[1]
+        if R > self._GRAM_CACHE_MAX_ROWS:
+            return
+        entry = self._stack_entry_for(field, bits)
+        if entry is None:
+            return
+        cached = entry.get("gram")
+        if cached is not None and cached[0] is bits:
+            return
+        lock = vars(field).setdefault("_stack_lock", threading.RLock())
+        hostg = vars(field).get("_gram_host")
+        if hostg is not None and hostg[0] == (entry.get("versions"), R):
+            # versions unchanged since the last full gram: reattach the
+            # host copy instead of relaunching
+            with lock:
+                if entry.get("dev") is bits:
+                    entry["gram"] = (bits, hostg[1])
+            return
+        from pilosa_tpu.ops import kernels
+
+        g = kernels.pair_gram(bits, list(range(R)))
+        if g is not None:
+            with lock:
+                if entry.get("dev") is bits:  # snapshot still current
+                    entry["gram"] = (bits, g)
+                    field._gram_host = ((entry.get("versions"), R), g)
 
     def _batch_general(
         self, idx: Index, calls: list[Call], shards: list[int] | None,

@@ -34,22 +34,38 @@ import time
 
 from pilosa_tpu.ingest.staging import DEFAULT_CAPACITY, StagingPool
 
+_STOP = object()
+
 
 class DeviceUploader:
-    """Double-buffered background host->device sync stage.
+    """Double-buffered background host->device sync stage, shared
+    between ingest and the residency prefetcher.
 
     ``submit(frag)`` enqueues a fragment whose mirror was just mutated;
     the uploader thread calls ``frag.device_bits()`` (the incremental
     word/row-scatter sync) off the apply path.  The slot queue is the
     double buffer: with the default two slots, one upload can be in
     flight while one more is staged, and a third submission blocks its
-    apply worker (bounded backlog, propagated backpressure)."""
+    apply worker (bounded backlog, propagated backpressure).
+
+    ``submit_prefetch(frag)`` rides the same thread on a SECOND,
+    lower-priority queue: the run loop only takes a prefetch item when
+    the ingest queue is empty, so predictive uploads for the next query
+    flight (server/batcher.py) can never delay an apply worker's sync.
+    Prefetch submission never blocks — a full prefetch queue drops the
+    item (the query path just pays its own upload, as before)."""
 
     def __init__(self, slots: int = 2, stats=None, applies_active=None):
         self.stats = stats
         self._applies_active = applies_active or (lambda: 0)
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, slots))
         self.slots = max(1, slots)
+        # prefetch backlog is wider than the ingest double buffer (a
+        # flight can stage many fragments at once) but still bounded:
+        # drop-on-full, never block
+        self._prefetch_q: "queue.Queue" = queue.Queue(
+            maxsize=max(8, slots * 8)
+        )
         self.uploads = 0
         self.uploads_coalesced = 0
         self.upload_errors = 0
@@ -58,10 +74,15 @@ class DeviceUploader:
         self.blocked_submits = 0
         self.blocked_seconds = 0.0
         self.upload_seconds = 0.0
+        self.prefetch_uploads = 0
+        self.prefetch_dropped = 0
+        self.prefetch_seconds = 0.0
         self._pending = 0
         self._queued: set[int] = set()  # id(frag) staged, not yet syncing
+        self._prefetch_queued: set[int] = set()
         self._pending_lock = threading.Lock()
         self._idle = threading.Condition(self._pending_lock)
+        self._wake = threading.Condition(self._pending_lock)
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="ingest-upload", daemon=True
@@ -88,6 +109,7 @@ class DeviceUploader:
                 return
             self._queued.add(id(frag))
             self._pending += 1
+            self._wake.notify()
         try:
             self._q.put_nowait(frag)
             return
@@ -97,6 +119,40 @@ class DeviceUploader:
         t0 = time.perf_counter()
         self._q.put(frag)
         self.blocked_seconds += time.perf_counter() - t0
+
+    def submit_prefetch(self, frag, done=None) -> bool:
+        """Stage a predictive upload on the low-priority queue; returns
+        True when actually queued.  Never blocks: a full queue or an
+        uploader busy with the same fragment's ingest sync drops the
+        request (False), and the query path pays its own upload exactly
+        as it would have without prefetch.  ``done(frag, err)`` runs on
+        the uploader thread after the sync attempt."""
+        if self._closed:
+            return False
+        # stack targets carry a stable identity across flights; raw
+        # fragments dedup on object id exactly like the ingest queue
+        key = getattr(frag, "prefetch_key", None)
+        if key is None:
+            key = id(frag)
+        with self._pending_lock:
+            if id(frag) in self._queued or key in self._prefetch_queued:
+                # already riding an ingest sync / earlier prefetch: that
+                # upload covers this request (device_bits reads latest)
+                return False
+            self._prefetch_queued.add(key)
+            self._pending += 1
+            self._wake.notify()
+        try:
+            self._prefetch_q.put_nowait((frag, key, done))
+            return True
+        except queue.Full:
+            self.prefetch_dropped += 1
+            with self._pending_lock:
+                self._prefetch_queued.discard(key)
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.notify_all()
+            return False
 
     def flush(self, timeout: float = 30.0) -> bool:
         """Block until every submitted upload has completed."""
@@ -109,16 +165,84 @@ class DeviceUploader:
                 self._idle.wait(remaining)
         return True
 
+    def _drain_prefetch(self) -> None:
+        """Discard staged prefetches at shutdown (predictive uploads are
+        advisory; flush() was the owner's chance to wait them out)."""
+        while True:
+            try:
+                self._prefetch_q.get_nowait()
+            except queue.Empty:
+                break
+            with self._idle:
+                self._pending -= 1
+                if self._pending == 0:
+                    self._idle.notify_all()
+        with self._pending_lock:
+            self._prefetch_queued.clear()
+
+    def _run_prefetch(self, frag, done) -> None:
+        """One predictive upload: marked as prefetch traffic so the
+        residency tracker books it apart from query hits/misses."""
+        from pilosa_tpu.core import residency
+
+        t0 = time.perf_counter()
+        err = None
+        tracker = residency.default_tracker()
+        tracker.enter_prefetch()
+        try:
+            frag.device_bits()
+        except Exception as e:  # advisory: the query path syncs lazily
+            err = e
+        finally:
+            tracker.exit_prefetch()
+        self.prefetch_uploads += 1
+        self.prefetch_seconds += time.perf_counter() - t0
+        if self.stats is not None:
+            self.stats.count("residency_prefetch_uploads", 1)
+        if done is not None:
+            try:
+                done(frag, err)
+            except Exception:
+                # the done callback is the prefetcher's own accounting
+                # hook; a bug there must not kill the uploader thread
+                tracker.note_prefetch_error()
+        with self._idle:
+            self._pending -= 1
+            if self._pending == 0:
+                self._idle.notify_all()
+
     def _run(self) -> None:
         while True:
-            frag = self._q.get()
+            done = None
+            pkey = None
+            is_prefetch = False
+            try:
+                frag = self._q.get_nowait()
+            except queue.Empty:
+                # ingest queue empty: a prefetch may ride the idle slot
+                # (strict priority — ingest is always drained first)
+                try:
+                    frag, pkey, done = self._prefetch_q.get_nowait()
+                    is_prefetch = True
+                except queue.Empty:
+                    with self._wake:
+                        if self._q.empty() and self._prefetch_q.empty():
+                            self._wake.wait(0.05)
+                    continue
             if frag is None:
+                self._drain_prefetch()
                 return
             # un-stage BEFORE syncing: an apply landing mid-sync must
             # queue a fresh sync (device_bits only covers state that
             # existed when it took the fragment lock)
             with self._pending_lock:
-                self._queued.discard(id(frag))
+                if is_prefetch:
+                    self._prefetch_queued.discard(pkey)
+                else:
+                    self._queued.discard(id(frag))
+            if is_prefetch:
+                self._run_prefetch(frag, done)
+                continue
             overlapped = self._applies_active() > 0
             t0 = time.perf_counter()
             nbytes = 0
@@ -171,6 +295,9 @@ class DeviceUploader:
             "blockedSubmits": self.blocked_submits,
             "blockedSeconds": round(self.blocked_seconds, 6),
             "uploadSeconds": round(self.upload_seconds, 6),
+            "prefetchUploads": self.prefetch_uploads,
+            "prefetchDropped": self.prefetch_dropped,
+            "prefetchSeconds": round(self.prefetch_seconds, 6),
         }
 
     def close(self) -> None:
@@ -178,6 +305,8 @@ class DeviceUploader:
             return
         self._closed = True
         self._q.put(None)
+        with self._pending_lock:
+            self._wake.notify()
         self._thread.join(timeout=5)
 
 

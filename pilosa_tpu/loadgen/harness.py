@@ -45,7 +45,14 @@ _HTTP_TIMEOUT = 30.0
 class StageSpec:
     """One load stage: ``rate`` ops/s for ``duration`` seconds across
     ``workers`` concurrent connections, drawing kinds from ``mix``
-    (None = the workload config's mix)."""
+    (None = the workload config's mix).
+
+    ``device_budget`` (bytes) caps the process-wide HBM budget for the
+    stage's duration and restores the previous cap after — the
+    oversubscription knob: a stage whose working set exceeds the cap
+    runs under live eviction pressure, and its report entry carries the
+    residency hit/miss/prefetch rates observed while it ran
+    (docs/residency.md)."""
 
     def __init__(
         self,
@@ -54,12 +61,16 @@ class StageSpec:
         rate: float,
         workers: int,
         mix: dict[str, float] | None = None,
+        device_budget: int | None = None,
     ):
         self.name = name
         self.duration = float(duration)
         self.rate = float(rate)
         self.workers = int(workers)
         self.mix = mix
+        self.device_budget = (
+            int(device_budget) if device_budget is not None else None
+        )
 
     @property
     def op_count(self) -> int:
@@ -72,6 +83,7 @@ class StageSpec:
             "rate": self.rate,
             "workers": self.workers,
             "mix": self.mix,
+            "deviceBudget": self.device_budget,
         }
 
 
@@ -186,6 +198,40 @@ def _fetch_json(base: str, path: str) -> dict | None:
         conn.close()
 
 
+def _residency_counters(base: str) -> dict | None:
+    """Monotonic residency counters from /debug/vars, flattened for
+    delta arithmetic (None when the node predates the residency plane)."""
+    dbg = _fetch_json(base, "/debug/vars")
+    if not dbg or "residency" not in dbg:
+        return None
+    res = dbg.get("residency") or {}
+    dev = dbg.get("device") or {}
+    return {
+        "deviceHits": res.get("deviceHits", 0),
+        "deviceMisses": res.get("deviceMisses", 0),
+        "prefetchIssued": res.get("prefetchIssued", 0),
+        "prefetchUseful": res.get("prefetchUseful", 0),
+        "evictions": dev.get("evictions", 0),
+    }
+
+
+def _residency_delta(
+    before: dict | None, after: dict | None
+) -> dict | None:
+    if before is None or after is None:
+        return None
+    delta = {k: after[k] - before[k] for k in before}
+    lookups = delta["deviceHits"] + delta["deviceMisses"]
+    delta["hitRate"] = (
+        delta["deviceHits"] / lookups if lookups else None
+    )
+    issued = delta["prefetchIssued"]
+    delta["prefetchUsefulFrac"] = (
+        delta["prefetchUseful"] / issued if issued else None
+    )
+    return delta
+
+
 def _fetch_text(base: str, path: str) -> str:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
@@ -248,6 +294,22 @@ class LoadHarness:
         stage_meta = []
         t_run0 = time.monotonic()
         for si, (stage, ops) in enumerate(zip(self.stages, per_stage_ops)):
+            # Oversubscription knob: cap the process-wide HBM budget for
+            # this stage only (the harness shares the servers' process —
+            # InProcessCluster — so the budget singleton is reachable
+            # directly), and restore the previous cap after the join so
+            # later stages run at their configured residency.  set_cap
+            # (not configure) so entries admitted by earlier stages stay
+            # accounted and the shrink evicts the live working set.
+            res_before = _residency_counters(self.uris[0])
+            prev_cap: tuple | None = None
+            if stage.device_budget is not None:
+                from pilosa_tpu.core import membudget
+
+                prev_cap = (membudget.default_budget().cap,)
+                # after the counter snapshot: the shrink's trim evictions
+                # belong to this stage's delta
+                membudget.set_cap(stage.device_budget)
             stop = threading.Event()
             q: "queue.Queue" = queue.Queue(maxsize=max(64, stage.workers * 8))
             outs = [_WorkerResult() for _ in range(stage.workers)]
@@ -292,6 +354,10 @@ class LoadHarness:
             if hook_thread is not None:
                 hook_thread.join()
             stop.set()
+            if prev_cap is not None:
+                from pilosa_tpu.core import membudget
+
+                membudget.set_cap(prev_cap[0])
             results.extend(outs)
             # Per-stage availability verdict: the share of this stage's
             # ops answered 2xx/3xx.  The resize stage's acceptance rides
@@ -311,6 +377,9 @@ class LoadHarness:
                     "availabilityOk": availability >= self.availability_floor,
                     "hookRan": hook is not None,
                     "hookError": hook_errors[0] if hook_errors else None,
+                    "residency": _residency_delta(
+                        res_before, _residency_counters(self.uris[0])
+                    ),
                 }
             )
         wall = time.monotonic() - t_run0
@@ -320,6 +389,13 @@ class LoadHarness:
         metrics_text = _fetch_text(self.uris[0], "/metrics")
         incidents = _fetch_json(self.uris[0], "/debug/incidents")
         events = _fetch_json(self.uris[0], "/debug/events")
+        final_vars = _fetch_json(self.uris[0], "/debug/vars")
+        residency = None
+        if final_vars and "residency" in final_vars:
+            residency = {
+                "residency": final_vars.get("residency"),
+                "device": final_vars.get("device"),
+            }
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -332,6 +408,7 @@ class LoadHarness:
             slo_metrics_present="pilosa_slo_requests_total" in metrics_text,
             incidents=incidents,
             events=events,
+            residency=residency,
         )
 
 

@@ -35,6 +35,7 @@ def build_report(
     slo_metrics_present: bool,
     incidents: dict | None = None,
     events: dict | None = None,
+    residency: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -98,6 +99,11 @@ def build_report(
         # here so SLO_r*.json is self-contained evidence of an online
         # membership change under load
         "events": (events or {}).get("events", []),
+        # end-of-run residency + HBM-budget snapshots (docs/residency.md):
+        # with an `oversubscribed` stage in the plan, the report carries
+        # the device hit/miss and prefetch useful/issued rates the
+        # working-set manager sustained under eviction pressure
+        "residency": residency,
         "verdicts": verdicts,
         "pass": overall,
     }

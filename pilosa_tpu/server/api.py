@@ -148,12 +148,25 @@ class API:
         from pilosa_tpu.server.batcher import QueryBatcher
 
         self.batcher = None
+        self.prefetcher = None
         if batch_window > 0 and batch_max_size > 1:
+            # Predictive residency prefetch (server/prefetch.py): the
+            # batcher's admission queue resolves each flight's cold
+            # fragments onto the ingest uploader's low-priority lane, so
+            # H2D staging overlaps compute under an oversubscribed HBM
+            # budget.  No-op while the budget is uncapped.
+            if self.ingest.uploader is not None:
+                from pilosa_tpu.server.prefetch import FlightPrefetcher
+
+                self.prefetcher = FlightPrefetcher(
+                    self.holder, self.ingest.uploader, self.executor
+                )
             self.batcher = QueryBatcher(
                 self.dist if self.dist is not None else self.executor,
                 stats=self.holder.stats,
                 window=batch_window,
                 max_batch=batch_max_size,
+                prefetcher=self.prefetcher,
             )
         # Online-migration state (cluster/migration.py): source-side
         # session registry (snapshot cut + delta tap per in-flight
@@ -1071,8 +1084,9 @@ class API:
         """Per-fragment storage/residency introspection plus a
         holder-level aggregate and the device budget block
         (/debug/fragments)."""
-        from pilosa_tpu.core import membudget
+        from pilosa_tpu.core import membudget, residency
 
+        tracker = residency.default_tracker()
         fragments = []
         now = time.time()
         for iname in self.holder.index_names():
@@ -1101,6 +1115,9 @@ class API:
                             counts_cached = frag._counts is not None
                             op_n = frag.op_n
                             mut_version = frag.version
+                            res_state = tracker.state_of(frag)
+                            res_pinned = frag._res_pinned
+                            res_heat = round(tracker.heat_of(frag), 3)
                         store = frag.store
                         last_snap = getattr(store, "last_snapshot_at", None)
                         d = {
@@ -1119,6 +1136,9 @@ class API:
                             "countsCached": counts_cached,
                             "opLogLength": op_n,
                             "version": mut_version,
+                            "residency": res_state,
+                            "pinned": res_pinned,
+                            "heat": res_heat,
                             "lastSnapshotAge": (
                                 now - last_snap if last_snap else None
                             ),
@@ -1131,11 +1151,16 @@ class API:
             "deviceResident": sum(1 for f in fragments if f["deviceResident"]),
             "deviceBytes": sum(f["deviceBytes"] for f in fragments),
             "opLogLength": sum(f["opLogLength"] for f in fragments),
+            "pinned": sum(1 for f in fragments if f["pinned"]),
+            "staging": sum(
+                1 for f in fragments if f["residency"] == residency.STATE_STAGING
+            ),
         }
         return {
             "fragments": fragments,
             "totals": totals,
             "device": membudget.default_budget().snapshot(),
+            "residency": tracker.snapshot(),
         }
 
     def resize_fetch(self, req: dict) -> dict:

@@ -108,8 +108,16 @@ class QueryBatcher:
         stats=None,
         window: float = 0.002,
         max_batch: int = 64,
+        prefetcher=None,
     ):
         self.executor = executor
+        # Flight-driven predictive prefetch (server/prefetch.py): the
+        # admission queue knows a flight's full (index, query, shards)
+        # set before any kernel launches, so not-yet-resident fragments
+        # are staged on the ingest uploader — submit-time staging
+        # overlaps the PREVIOUS flight's compute; the window-close pass
+        # catches members whose submit-time staging was dropped.
+        self.prefetcher = prefetcher
         # gauge/histogram exist on MemStatsClient but not on every
         # StatsClient implementation; degrade to no metrics, not errors
         self.stats = stats if hasattr(stats, "gauge") else None
@@ -145,6 +153,15 @@ class QueryBatcher:
             if self.stats is not None:
                 self.stats.count("batcher_deadline_bypass", 1, 1.0)
             return self.executor.execute(index, query, shards=shards)
+        if self.prefetcher is not None:
+            try:
+                # stage this query's cold fragments NOW (handler thread,
+                # profile context live -> residency.prefetch span): the
+                # upload rides the uploader while the current flight
+                # computes, instead of stalling this one's dispatch
+                self.prefetcher.prefetch_query(index, query, shards)
+            except Exception:
+                logger.debug("prefetch failed", exc_info=True)
         item = _Flight(index, query, shards)
         with self._lock:
             direct = self._closed
@@ -188,6 +205,16 @@ class QueryBatcher:
                 break
             batch, reason = self._collect(first)
             stopping = reason == "drain"
+            if self.prefetcher is not None:
+                try:
+                    # window close: the flight's full shard set is known;
+                    # re-stage anything whose submit-time prefetch was
+                    # dropped while the uploader serviced ingest
+                    self.prefetcher.prefetch_flight(
+                        [(f.index, f.query, f.shards) for f in batch]
+                    )
+                except Exception:
+                    logger.debug("flight prefetch failed", exc_info=True)
             self._dispatch(batch, reason)
 
     def _urgent(self, item: _Flight) -> bool:

@@ -277,7 +277,7 @@ class Handler(BaseHTTPRequestHandler):
         registry (ops/kernels.kernel_stats) so it is visible even when
         the holder uses a NopStatsClient; both registries are rendered
         into the one scrape."""
-        from pilosa_tpu.core import membudget, translate
+        from pilosa_tpu.core import membudget, residency, translate
         from pilosa_tpu.obs.stats import prometheus_text
         from pilosa_tpu.ops import kernels
 
@@ -290,6 +290,16 @@ class Handler(BaseHTTPRequestHandler):
             stats.gauge("device_cap_bytes", dev["capBytes"] or 0)
             stats.gauge("device_entries", dev["entries"])
             stats.gauge("device_evictions", dev["evictions"])
+            # residency tiers: query-path hit/miss, predictive-prefetch
+            # yield, and the pin working set (core/residency.py)
+            res = residency.default_tracker().snapshot()
+            stats.gauge("device_hits", res["deviceHits"])
+            stats.gauge("device_misses", res["deviceMisses"])
+            stats.gauge("device_prefetch_issued", res["prefetchIssued"])
+            stats.gauge("device_prefetch_useful", res["prefetchUseful"])
+            stats.gauge("device_pins", dev["pins"])
+            stats.gauge("device_pinned_entries", dev["pinnedEntries"])
+            stats.gauge("device_pinned_bytes", dev["pinnedBytes"])
         # Kernel + key-translation telemetry live in process-global
         # registries (visible under NopStatsClient holders); the SLO
         # plane renders its own pilosa_slo_* series from the tracker.
@@ -327,11 +337,14 @@ class Handler(BaseHTTPRequestHandler):
                 "stack_incremental": ex.stack_incremental,
                 "bsi_stack_launches": ex.bsi_stack_launches,
             }
-        from pilosa_tpu.core import membudget, translate
+        from pilosa_tpu.core import membudget, residency, translate
         from pilosa_tpu.ops import kernels
 
         snap["kernels"] = kernels.telemetry_snapshot()
         snap["device"] = membudget.default_budget().snapshot()
+        # residency-tier counters: hit/miss rates, prefetch yield, pin
+        # policy outcomes (core/residency.py)
+        snap["residency"] = residency.default_tracker().snapshot()
         snap["events"] = self.api.holder.events.snapshot_summary()
         snap["slo"] = self.api.holder.slo.summary()
         snap["translate"] = translate.telemetry_snapshot()

@@ -187,8 +187,17 @@ class NodeServer:
         flightrec_spike_504: int = 5,
         resize_watchdog_deadline: float = 15.0,
         mesh_dispatch: bool = True,
+        device_budget: int | None = None,
     ):
         self.host = host
+        # HBM budget override: device memory is process-global (one
+        # accelerator per process), so this reconfigures the singleton
+        # cap — last-configured node wins in multi-node test processes.
+        # None keeps the probed/env default (membudget.default_budget).
+        if device_budget is not None:
+            from pilosa_tpu.core import membudget
+
+            membudget.configure(device_budget)
         self.tls = bool(tls_cert)
         # Cluster-on-mesh: advertise this node's holder in the process
         # placement map on start() so in-process peers (one process per

@@ -25,6 +25,7 @@ PER_FILE = [
     "span_discipline",
     "log_discipline",
     "queue_discipline",
+    "residency_discipline",
 ]
 
 
@@ -109,6 +110,20 @@ class TestBadCorpusCoverage:
         assert "maxsize=0) is unbounded" in msgs
         assert "maxsize=-1) is unbounded" in msgs
         assert "SimpleQueue" in msgs
+
+    def test_residency_classes(self):
+        findings = _check_corpus_file("residency_discipline", "bad")
+        # plain, annotated, tuple-unpacked, and setattr forms all fire
+        assert len(findings) == 5
+        assert all(
+            "bypasses the residency manager" in f.message for f in findings
+        )
+
+    def test_residency_manager_itself_exempt(self):
+        p = BY_ID["residency-discipline"]
+        assert not p.applies("pilosa_tpu/core/fragment.py")
+        assert p.applies("pilosa_tpu/exec/executor.py")
+        assert p.applies("tests/test_residency.py")
 
 
 class TestDispatchParity:

@@ -147,6 +147,23 @@ def test_default_stage_plan_has_range_heavy_stage():
     assert {OP_CLASS[k] for k in RANGE_HEAVY_MIX} >= {"read.range", "write"}
 
 
+def test_default_stage_plan_has_oversubscribed_stage():
+    from tools.loadharness import OVERSUB_MIX, default_stages, oversub_budget
+
+    stages = default_stages(duration=10.0, rate=100.0, workers=4)
+    [ov] = [s for s in stages if s.name == "oversubscribed"]
+    assert ov.mix is OVERSUB_MIX
+    assert ov.device_budget == oversub_budget() > 0
+    assert ov.to_dict()["deviceBudget"] == ov.device_budget
+    # stack-consuming reads dominate the mix
+    assert max(OVERSUB_MIX, key=OVERSUB_MIX.get) == "count"
+    # the stages around it run unbudgeted (full residency)
+    assert stages[-1].name == "ramp" and stages[-1].device_budget is None
+    assert stages[0].device_budget is None
+    # the plan's total duration is preserved at a fifth per stage
+    assert sum(s.duration for s in stages) == pytest.approx(10.0)
+
+
 def test_time_quantum_ops_carry_timestamps():
     g = WorkloadGenerator(WorkloadConfig(seed=2))
     ops = g.sequence(50, mix={"set_tq": 1.0, "range_time": 1.0})
@@ -256,6 +273,52 @@ def test_short_harness_run_emits_valid_report():
     # the server saw the same classes the client drove
     for cls in report["ops"]:
         assert report["serverSLO"]["classes"][cls]["total"] > 0
+
+
+def test_budgeted_stage_caps_then_restores_and_reports_residency():
+    # a device_budget stage must (a) cap the process-global HBM budget
+    # for exactly its own duration, (b) attach a residency counter delta
+    # to its stage entry, and (c) land the end-of-run residency block in
+    # the report — all without breaking the report schema
+    import jax
+
+    from pilosa_tpu.core import membudget
+    from pilosa_tpu.shardwidth import SHARD_WORDS
+
+    prev = membudget.default_budget().cap
+    budget = jax.local_device_count() * 48 * SHARD_WORDS * 4
+    cfg = WorkloadConfig(seed=23, n_cols=5_000)
+    try:
+        report = run_harness(
+            cfg,
+            [
+                StageSpec("oversubscribed", 1.0, 40.0, 3,
+                          {"count": 3.0, "row": 1.0}, device_budget=budget),
+                StageSpec("after", 0.5, 20.0, 2, {"count": 1.0}),
+            ],
+            nodes=1,
+            preload_bits=256,
+        )
+        cap_after_run = membudget.default_budget().cap
+    finally:
+        membudget.configure(prev)
+    validate_report(report)
+    ov, after = report["stages"]
+    assert ov["deviceBudget"] == budget
+    assert after["deviceBudget"] is None
+    for st in (ov, after):
+        delta = st["residency"]
+        assert delta is not None
+        for key in ("deviceHits", "deviceMisses", "prefetchIssued",
+                    "prefetchUseful", "evictions", "hitRate"):
+            assert key in delta, (st["name"], key)
+        assert delta["deviceHits"] >= 0 and delta["deviceMisses"] >= 0
+    assert report["residency"] is not None
+    assert "capBytes" in report["residency"]["device"]
+    assert "deviceHits" in report["residency"]["residency"]
+    # the budget cap was restored after the budgeted stage
+    assert cap_after_run == prev
+    assert json.dumps(report)
 
 
 def test_range_heavy_harness_run_serves_read_range():

@@ -1,0 +1,23 @@
+"""residency-discipline bad corpus."""
+
+
+def drop_by_hand(frag):
+    # writes the device tier without releasing the budget entry
+    frag._device = None
+
+
+def install_by_hand(frag, arr):
+    # untracked device copy: the budget can never evict it
+    frag._device = arr
+
+
+def annotated(frag, arr):
+    frag._device: object = arr
+
+
+def unpacked(frag, a, b):
+    frag._device, frag.other = a, b
+
+
+def dynamic(frag, arr):
+    setattr(frag, "_device", arr)

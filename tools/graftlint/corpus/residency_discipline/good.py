@@ -1,0 +1,19 @@
+"""residency-discipline good corpus: read the tier, transition through
+the manager."""
+
+
+def peek(frag):
+    # racy reads are fine — introspection never takes query-path locks
+    return frag._device is not None
+
+
+def promote(frag):
+    return frag.device_bits()
+
+
+def demote(frag):
+    frag._drop_device()
+
+
+def unrelated_attr(frag, arr):
+    frag._device_shadow = arr  # a different attribute entirely

@@ -9,6 +9,7 @@ from tools.graftlint.passes import (
     lock_discipline,
     log_discipline,
     queue_discipline,
+    residency_discipline,
     span_discipline,
     timeout_discipline,
     tpu_purity,
@@ -25,6 +26,7 @@ ALL_PASSES = [
     dispatch_parity,
     log_discipline,
     queue_discipline,
+    residency_discipline,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

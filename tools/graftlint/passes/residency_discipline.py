@@ -1,0 +1,85 @@
+"""residency-discipline: device copies move through the residency
+manager, never by direct ``._device`` assignment.
+
+Invariant: ``Fragment._device`` is the device tier of the residency
+state machine (docs/residency.md).  Every legal transition lives in
+``pilosa_tpu/core/fragment.py`` — ``device_bits()`` admits/touches the
+budget, books the hit/miss/prefetch outcome, and bumps heat;
+``_drop_device()`` releases the budget entry and clears the tier flags.
+A direct ``frag._device = ...`` anywhere else writes the tier without
+the bookkeeping: the budget's byte accounting drifts (an untracked copy
+can never be evicted, a zeroed one double-frees on the next release),
+``/debug/fragments`` reports a phantom tier, and the prefetch
+useful/issued ratio silently rots.  The same goes for the dynamic form,
+``setattr(frag, "_device", ...)``.
+
+Reads are fine — introspection peeks at ``._device`` racily by design.
+
+Scope: the whole tree except the manager itself.  Tests included: a
+test that wants a cold fragment calls ``_drop_device()``, which keeps
+the accounting exact.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import Finding
+
+PASS_ID = "residency-discipline"
+DESCRIPTION = (
+    "fragment ._device is assigned only inside the residency manager "
+    "(core/fragment.py); use device_bits()/_drop_device()"
+)
+
+_MANAGER = "pilosa_tpu/core/fragment.py"
+
+_MSG = (
+    "direct ._device assignment bypasses the residency manager: the "
+    "budget's byte accounting and the tier state drift (use "
+    "device_bits() to promote, _drop_device() to demote — "
+    "core/fragment.py owns this transition)"
+)
+
+
+def applies(path: str) -> bool:
+    return not path.replace("\\", "/").endswith(_MANAGER)
+
+
+def _assigned_device_attr(node: ast.AST):
+    """Yield Attribute targets named ``_device`` being written."""
+    targets: list[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        for sub in ast.walk(t):  # tuple/starred unpacking
+            if isinstance(sub, ast.Attribute) and sub.attr == "_device":
+                yield sub
+
+
+def check(path: str, tree: ast.AST, lines: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        for attr in _assigned_device_attr(node):
+            findings.append(
+                Finding(path, attr.lineno, attr.col_offset, PASS_ID, _MSG)
+            )
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if (
+                name == "setattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == "_device"
+            ):
+                findings.append(
+                    Finding(
+                        path, node.lineno, node.col_offset, PASS_ID, _MSG
+                    )
+                )
+    return findings

@@ -6,11 +6,15 @@ report next to the ``BENCH_*.json`` artifacts.
 
 Default stage plan (scaled by --duration/--rate/--workers):
 
-    warm        read-heavy mix at half rate/concurrency
-    timequantum streaming timestamped SetBit + concurrent Range reads
-    rangescan   int-field range predicates (the query-batched BSI lane)
-                with interleaved value writes
-    ramp        full mix at full rate and concurrency
+    warm           read-heavy mix at half rate/concurrency
+    timequantum    streaming timestamped SetBit + concurrent Range reads
+    rangescan      int-field range predicates (the query-batched BSI lane)
+                   with interleaved value writes
+    oversubscribed zipfian stack-heavy reads under a deliberately tiny
+                   HBM budget (stage-scoped ``device_budget``), so the
+                   report carries residency hit/miss and prefetch
+                   useful/issued rates under live eviction pressure
+    ramp           full mix at full rate and concurrency (budget restored)
 
 Examples::
 
@@ -62,15 +66,43 @@ RANGE_HEAVY_MIX = {
     "range_bsi": 42.0, "set_val": 18.0, "count": 12.0, "row": 8.0,
     "groupby": 6.0, "set": 8.0, "translate": 6.0,
 }
+# Oversubscribed: stack-consuming reads dominate (count's Intersect arm,
+# groupby, topn, range_bsi all stage field stacks / BSI planes), with
+# enough write traffic to keep invalidating what the budget admitted.
+# Run under a stage-scoped device_budget smaller than the working set,
+# this is the eviction-pressure lane of the stage plan.
+OVERSUB_MIX = {
+    "count": 40.0, "range_bsi": 20.0, "row": 12.0, "groupby": 8.0,
+    "topn": 6.0, "set": 8.0, "translate": 6.0,
+}
+
+
+def oversub_budget() -> int:
+    """HBM cap for the oversubscribed stage: ~1.1x one seg-field stack
+    ([devices, 32 rows, words] uint32 — the shard axis pads up to the
+    mesh).  The stage's hot set is the seg stack PLUS the BSI slice
+    planes (plus time views and row caches from earlier stages), so the
+    cap admits any one of them but not the set — the count and range_bsi
+    arms of the mix then churn the clock hand against each other for the
+    stage's whole duration."""
+    import jax
+
+    from pilosa_tpu.shardwidth import SHARD_WORDS
+
+    return jax.local_device_count() * 36 * SHARD_WORDS * 4
 
 
 def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
-    quarter = max(1.0, duration / 4.0)
+    fifth = max(1.0, duration / 5.0)
     return [
-        StageSpec("warm", quarter, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
-        StageSpec("timequantum", quarter, rate, workers, TIMEQUANTUM_MIX),
-        StageSpec("rangescan", quarter, rate, workers, RANGE_HEAVY_MIX),
-        StageSpec("ramp", quarter, rate * 1.5, workers, None),
+        StageSpec("warm", fifth, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", fifth, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("rangescan", fifth, rate, workers, RANGE_HEAVY_MIX),
+        StageSpec(
+            "oversubscribed", fifth, rate, workers, OVERSUB_MIX,
+            device_budget=oversub_budget(),
+        ),
+        StageSpec("ramp", fifth, rate * 1.5, workers, None),
     ]
 
 
@@ -114,7 +146,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--nodes", type=int, default=1)
     ap.add_argument("--duration", type=float, default=9.0,
-                    help="total seconds across the three stages")
+                    help="total seconds across the stage plan")
     ap.add_argument("--rate", type=float, default=150.0,
                     help="open-loop arrival rate (ops/s) of the full-load stages")
     ap.add_argument("--workers", type=int, default=8)
@@ -189,10 +221,21 @@ def main(argv: list[str] | None = None) -> int:
             f"p999={c['p999Ms']:.2f}ms"
         )
     for st in report["stages"]:
+        res = st.get("residency")
+        res_note = ""
+        if res and st.get("deviceBudget") is not None:
+            hr = res.get("hitRate")
+            uf = res.get("prefetchUsefulFrac")
+            res_note = (
+                f" hitRate={hr:.3f}" if hr is not None else " hitRate=n/a"
+            ) + (
+                f" prefetchUseful={uf:.3f}" if uf is not None else ""
+            ) + f" evictions={res.get('evictions', 0)}"
         print(
             f"  stage {st['name']:<14} avail={st['availability']:.4f} "
             f"{'OK' if st['availabilityOk'] else 'LOW'}"
             + (f" hookError={st['hookError']}" if st.get("hookError") else "")
+            + res_note
         )
     for name, v in report["verdicts"].items():
         print(f"  verdict {name:<14} {'PASS' if v['pass'] else 'FAIL'}")
