@@ -246,7 +246,12 @@ def _served_concurrency_sweep() -> dict:
     the sweep, so the JSON shows HOW the throughput was achieved."""
     from pilosa_tpu.server.node import NodeServer
 
-    srv = NodeServer(port=0, batch_window=0.002, batch_max_size=128)
+    # rescache off: the sweep repeats ONE query, so with the semantic
+    # cache live every request past the first would demux as a cache
+    # hit and the lane would stop measuring the admission batcher
+    srv = NodeServer(
+        port=0, batch_window=0.002, batch_max_size=128, rescache_entries=0
+    )
     srv.start()
     try:
         api = srv.api
@@ -364,7 +369,10 @@ def _recorder_overhead_lane() -> dict:
     from pilosa_tpu.server.node import NodeServer
 
     def boot(recorder: bool):
-        srv = NodeServer(port=0, flight_recorder=recorder)
+        # rescache off: a cache hit skips the execution the recorder
+        # observes, so the overhead under test would vanish from the
+        # measured path
+        srv = NodeServer(port=0, flight_recorder=recorder, rescache_entries=0)
         srv.start()
         api = srv.api
         if not recorder:
@@ -476,9 +484,11 @@ def _mesh_dist_lane() -> dict:
         "range": "Count(Row(v > 500000))",
     }
     http_calls = []
-    with InProcessCluster(8, replica_n=1) as mesh_c, InProcessCluster(
-        1
-    ) as solo_c:
+    # rescache off on both sides: the lane repeats three fixed queries,
+    # and a cache hit would bypass the mesh dispatch under test
+    with InProcessCluster(
+        8, replica_n=1, rescache_entries=0
+    ) as mesh_c, InProcessCluster(1, rescache_entries=0) as solo_c:
         seed(mesh_c)
         seed(solo_c)
         qi = next(
@@ -576,7 +586,9 @@ def _residency_lane() -> dict:
     weights = [1.0 / (fi + 1) ** 1.3 for fi in range(n_fields)]
 
     def run_phase(cap_of_total):
-        api = API(batch_window=0.004, batch_max_size=64)
+        # rescache off: the zipfian repeats would otherwise be served
+        # from the result cache without ever touching HBM residency
+        api = API(batch_window=0.004, batch_max_size=64, rescache_entries=0)
         try:
             api.create_index("ri")
             rng = np.random.default_rng(31)
@@ -664,6 +676,150 @@ def _residency_lane() -> dict:
         "resident_prefetch_issued": resident["residency"]["prefetchIssued"],
         "pass_qps_ratio": ratio is not None and ratio >= 0.25,
         "pass_useful_frac": useful_frac >= 0.5,
+    }
+
+
+def _rescache_lane(serving_floor_ms: float) -> dict:
+    """Semantic result cache lane (docs/caching.md): the SAME zipfian
+    repeat-heavy read schedule with interleaved writes through the
+    in-process batched API twice — cache on (the serving default) vs
+    ``rescache_entries=0`` — over identical data.  The write traffic is
+    mostly to a field no read template touches, which is the point:
+    version-precise invalidation keeps the pool's entries live under
+    unrelated writes, while the periodic writes that DO hit a read
+    field force invalidate-then-refill (and maintained-view refresh for
+    the promoted TopN).  Acceptance bars: cache-served read p50 below
+    the uncached serving-cache floor, and cached/uncached qps >= 5x."""
+    import random as _random
+
+    from pilosa_tpu.server.api import API
+
+    n_ops = 480
+    pool_theta = 1.2
+
+    def seed(api):
+        api.create_index("rc")
+        api.create_field("rc", "f")
+        api.create_field("rc", "g")
+        api.create_field("rc", "v", {"type": "int", "min": 0, "max": 1_000_000})
+        api.create_field("rc", "w")
+        rng = np.random.default_rng(17)
+        width = api.holder.n_words * 32
+        writes = []
+        for row in range(8):
+            for c in rng.integers(0, width, size=100):
+                writes.append(f"Set({int(c)}, f={row})")
+        for row in range(4):
+            for c in rng.integers(0, width, size=60):
+                writes.append(f"Set({int(c)}, g={row})")
+        for c in sorted({int(c) for c in rng.integers(0, width, size=200)}):
+            writes.append(f"Set({c}, v={c % 999_983})")
+        api.query("rc", " ".join(writes))
+
+    # zipfian head first: the hot templates are the expensive shapes,
+    # the dashboard-refresh pattern the cache exists for
+    pool = [
+        "GroupBy(Rows(f), Rows(g))",
+        "TopN(f, n=5)",
+        "Count(Intersect(Row(f=0), Row(f=1)))",
+        "Count(Row(v < 500000))",
+        "Sum(field=v)",
+        "Count(Union(Row(f=2), Row(g=1)))",
+        "TopN(g, n=3)",
+        "Count(Difference(Row(f=0), Row(g=0)))",
+        "Count(Row(v > 250000))",
+        "Min(field=v)",
+        "Max(field=v)",
+        "Count(Row(f=3))",
+    ]
+    weights = [1.0 / (i + 1) ** pool_theta for i in range(len(pool))]
+
+    # both blocks' schedules are pre-drawn from ONE seeded stream so the
+    # two sides replay byte-identical traffic and the timed loops hold
+    # nothing but api.query
+    r = _random.Random(23)
+    n_hit = 400
+    hit_reads = [r.choices(pool, weights=weights)[0] for _ in range(n_hit)]
+    mixed_reads = [r.choices(pool, weights=weights)[0] for _ in range(n_ops)]
+
+    def run_side(entries: int) -> dict:
+        api = API(
+            batch_window=0.004, batch_max_size=64, rescache_entries=entries
+        )
+        try:
+            seed(api)
+            # warm both sides identically: fills the cache on the
+            # cached side, warms the per-snapshot serving caches on both
+            for q in pool:
+                api.query("rc", q)
+            # hit block: pure zipfian repeats over the warm pool — on
+            # the cached side every read is cache-served, so this pair
+            # of walls IS the hit-qps vs uncached-qps ratio
+            lats: list[float] = []
+            t0 = time.perf_counter()
+            for q in hit_reads:
+                tq = time.perf_counter()
+                api.query("rc", q)
+                lats.append(time.perf_counter() - tq)
+            hit_wall = time.perf_counter() - t0
+            # mixed block: the same reads with interleaved writes — the
+            # invalidation-under-traffic realism the hit block omits
+            snap0 = api.executor.rescache.snapshot()
+            wcol = 0
+            t0 = time.perf_counter()
+            for i, q in enumerate(mixed_reads):
+                if i % 8 == 7:
+                    wcol += 1
+                    if (i // 8) % 5 == 4:
+                        # every 5th write lands on a read field:
+                        # invalidate (or maintained-refresh) + refill
+                        api.query("rc", f"Set({wcol}, f=6)")
+                    else:
+                        api.query("rc", f"Set({wcol}, w=1)")
+                else:
+                    api.query("rc", q)
+            mixed_wall = time.perf_counter() - t0
+            snap1 = api.executor.rescache.snapshot()
+            lats.sort()
+            return {
+                "hit_qps": n_hit / hit_wall,
+                "hit_p50_ms": lats[len(lats) // 2] * 1e3,
+                "mixed_qps": n_ops / mixed_wall,
+                "delta": {
+                    k: snap1[k] - snap0[k]
+                    for k in (
+                        "hits", "misses", "invalidations", "promotions",
+                        "maintainedHits",
+                    )
+                },
+            }
+        finally:
+            api.close()
+
+    cached = run_side(512)
+    uncached = run_side(0)
+    d = cached["delta"]
+    reads = d["hits"] + d["misses"]
+    ratio = (
+        round(cached["hit_qps"] / uncached["hit_qps"], 2)
+        if uncached["hit_qps"]
+        else None
+    )
+    return {
+        "rescache_hit_qps": round(cached["hit_qps"], 1),
+        "uncached_qps": round(uncached["hit_qps"], 1),
+        "rescache_hit_vs_uncached": ratio,
+        "hit_p50_ms": round(cached["hit_p50_ms"], 4),
+        "uncached_p50_ms": round(uncached["hit_p50_ms"], 4),
+        "serving_floor_ms": round(serving_floor_ms, 4),
+        # mixed-block context: blended throughput and the cache's own
+        # accounting while writes invalidate / refresh underneath
+        "mixed_qps_cached": round(cached["mixed_qps"], 1),
+        "mixed_qps_uncached": round(uncached["mixed_qps"], 1),
+        "hit_rate": round(d["hits"] / reads, 3) if reads else None,
+        **{f"cache_{k}": v for k, v in d.items()},
+        "pass_hit_p50": cached["hit_p50_ms"] < serving_floor_ms,
+        "pass_hit_ratio": ratio is not None and ratio >= 5.0,
     }
 
 
@@ -995,7 +1151,10 @@ def main() -> None:
     _idx.create_field("f")
     _idx.create_field("g")
     _idx.create_field("v", FieldOptions(field_type="int", min_=0, max_=10**6))
-    _ex = _Executor(_h)
+    # rescache off: these numbers are the UNCACHED serving floor the
+    # semantic-cache lane compares its hit path against (a repeat query
+    # would otherwise measure a cache hit, not the executor)
+    _ex = _Executor(_h, rescache_entries=0)
     srv_rng = np.random.default_rng(5)
     srv_width = _h.n_words * 32
     srv_writes = []
@@ -1056,6 +1215,16 @@ def main() -> None:
         residency_lane = _residency_lane()
     except Exception as e:
         print(f"warning: residency lane failed: {e}", file=sys.stderr)
+
+    # -- semantic result cache lane: zipfian repeat-heavy reads with
+    # interleaved writes, cache on vs off over identical data; the
+    # floor is the cheapest uncached serving number above (the lane
+    # must never sink the bench)
+    rescache_lane = None
+    try:
+        rescache_lane = _rescache_lane(min(serving.values()))
+    except Exception as e:
+        print(f"warning: rescache lane failed: {e}", file=sys.stderr)
 
     # -- SLO harness lane: a short seeded mixed-workload burst through
     # the full HTTP path with the server's error-budget tracker live
@@ -1580,6 +1749,14 @@ def main() -> None:
         "residency_prefetch_useful_frac": (
             (residency_lane or {}).get("prefetch_useful_frac")
         ),
+        # semantic result cache lane: cache-served p50 must undercut the
+        # uncached serving floor and cached/uncached qps >= 5x are the
+        # cache's bars (docs/caching.md)
+        "rescache": rescache_lane,
+        "rescache_hit_vs_uncached": (
+            (rescache_lane or {}).get("rescache_hit_vs_uncached")
+        ),
+        "rescache_hit_p50_ms": ((rescache_lane or {}).get("hit_p50_ms")),
         "probe": _PROBE_ATTEMPTS,
         "probe_warnings": _PROBE_WARNINGS,
         "forced_cpu": _FORCED_CPU,

@@ -200,6 +200,22 @@ class DistributedExecutor:
                 for c, r in zip(q.calls, results)
             ]
 
+    def rescache_probe(
+        self,
+        index_name: str,
+        q: pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any] | None:
+        """Batcher-side semantic cache probe (server/batcher.py).  Only
+        the single-node case probes the local full-result cache: on a
+        multi-node coordinator a local probe cannot observe remote
+        owners' fragment versions, so correctness rides the per-owner
+        partial caches underneath (_map_partials / mesh facade) and the
+        remote nodes' own executors instead."""
+        if self._single:
+            return self.local.rescache_probe(index_name, q, shards)
+        return None
+
     def execute_remote(
         self, index_name: str, query: str | pql.Query, shards: list[int] | None
     ) -> list[Any]:
@@ -486,8 +502,11 @@ class DistributedExecutor:
                             pending.extend(nshards)
                 if local_shards is not None:
                     decision["localShards"] += len(local_shards)
+                    # local partial through the semantic cache: repeat
+                    # fan-outs reuse this node's partial under its own
+                    # fragment version subvector (exec/rescache.py)
                     partials.append(
-                        self.local._execute_call(idx, call, local_shards)
+                        self.local.cached_execute_call(idx, call, local_shards)
                     )
                 if futures:
                     fanout = tracing.start_span("dist.httpFanout")
@@ -546,7 +565,13 @@ class DistributedExecutor:
                     for nid, (holder, gen, sh) in owners.items()
                 },
             )
-            ex = Executor(view, translator=self.local.translator)
+            ex = Executor(
+                view,
+                translator=self.local.translator,
+                rescache_entries=self.local.rescache.max_entries,
+                rescache_promote_hits=self.local.rescache.promote_hits,
+                rescache_demote_deltas=self.local.rescache.demote_deltas,
+            )
             self._mesh_cache[key] = ex
             while len(self._mesh_cache) > self._MESH_CACHE_ENTRIES:
                 self._mesh_cache.popitem(last=False)
@@ -579,7 +604,13 @@ class DistributedExecutor:
         with span, qprofile.span(
             "meshDispatch", nodes=len(owners), shards=len(shards)
         ):
-            out = ex._execute_call(fidx, call, shards)
+            # through the facade executor's own semantic cache: the
+            # partial is keyed by the owners' REAL fragment versions
+            # (MeshView resolves to live fragments), and the facade
+            # executor itself is cached per shard assignment, so a
+            # resize epoch / shard flip rotates to a fresh cache while
+            # fragment epochs fence any survivor entries
+            out = ex.cached_execute_call(fidx, call, shards)
         self.mesh_dispatches += 1
         self.holder.stats.count("dist_mesh_local_total", 1)
         return out
@@ -775,7 +806,22 @@ class DistributedExecutor:
             "meshDispatches": self.mesh_dispatches,
             "meshFallbacks": self.mesh_fallbacks,
             "recentPartitions": list(self._partition_log),
+            # facade executors' partial caches, aggregated: mesh-leg
+            # repeats served without re-launching the collective
+            "meshRescache": self._mesh_rescache_totals(),
         }
+
+    def _mesh_rescache_totals(self) -> dict:
+        totals = {"hits": 0, "misses": 0, "invalidations": 0, "entries": 0}
+        with self._mesh_cache_lock:
+            executors = list(self._mesh_cache.values())
+        for ex in executors:
+            snap = ex.rescache.snapshot()
+            totals["hits"] += snap["hits"]
+            totals["misses"] += snap["misses"]
+            totals["invalidations"] += snap["invalidations"]
+            totals["entries"] += snap["entries"]
+        return totals
 
     def _query_remote(
         self,

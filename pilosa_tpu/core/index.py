@@ -8,6 +8,7 @@ existenceFieldName)."""
 
 from __future__ import annotations
 
+import itertools
 import threading
 
 from pilosa_tpu.core.attrs import AttrStore
@@ -19,6 +20,11 @@ EXISTENCE_FIELD_NAME = "_exists"
 
 
 class Index:
+    # process-unique creation sequence: a dropped-and-recreated index of
+    # the same name must never alias cache keys of its predecessor
+    # (exec/rescache.py keys on it)
+    _SEQ = itertools.count()
+
     def __init__(
         self,
         name: str,
@@ -32,6 +38,11 @@ class Index:
         self.track_existence = track_existence
         self.n_words = n_words
         self._lock = threading.RLock()
+        self.seq = next(Index._SEQ)
+        # schema generation: bumped on field create/delete so semantic
+        # cache keys built against the old field set can't survive a
+        # schema change (exec/rescache.py)
+        self.generation = 0
         self.fields: dict[str, Field] = {}
         # column attributes (reference index.go columnAttrs boltdb store)
         self.column_attrs = AttrStore()
@@ -65,6 +76,7 @@ class Index:
             f = Field(self.name, name, options, self.n_words)
             f.stats = self.stats.with_tags(f"field:{name}")
             self.fields[name] = f
+            self.generation += 1
             if self.on_create_field is not None:
                 self.on_create_field(self, f)
             return f
@@ -79,7 +91,10 @@ class Index:
     def delete_field(self, name: str) -> bool:
         """reference index.go:430-453."""
         with self._lock:
-            return self.fields.pop(name, None) is not None
+            gone = self.fields.pop(name, None) is not None
+            if gone:
+                self.generation += 1
+            return gone
 
     def field_names(self, include_internal: bool = False) -> list[str]:
         return sorted(

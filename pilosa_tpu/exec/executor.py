@@ -40,6 +40,7 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec import rescache
 from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
@@ -118,9 +119,22 @@ class Executor:
         holder: Holder,
         translator: TranslateStore | None = None,
         max_writes_per_request: int | None = None,
+        rescache_entries: int = 512,
+        rescache_promote_hits: int = 3,
+        rescache_demote_deltas: int = 64,
     ):
         self.holder = holder
         self.translator = translator or TranslateStore()
+        # semantic result cache (exec/rescache.py, docs/caching.md):
+        # translated read calls keyed by canonical AST + fragment version
+        # vector, probed ahead of the batch fast paths; 0 entries
+        # disables it
+        self.rescache = rescache.ResultCache(
+            entries=rescache_entries,
+            promote_hits=rescache_promote_hits,
+            demote_deltas=rescache_demote_deltas,
+            stats_fn=lambda: holder.stats,
+        )
         # mutating-call cap per request (reference executor.go:55,138 +
         # config max-writes-per-request); 0 disables
         self.max_writes_per_request = (
@@ -184,6 +198,14 @@ class Executor:
             first_write = next(
                 (i for i, c in enumerate(calls) if _is_write(c)), len(calls)
             )
+            # Semantic cache probe ahead of kernel dispatch: a repeated
+            # read whose fragment version vector is unchanged skips the
+            # batch passes entirely (exec/rescache.py).
+            tokens: list[Any] = [None] * len(calls)
+            for i, call in enumerate(calls[:first_write]):
+                res, tokens[i] = self.rescache.lookup(idx, call, shards)
+                if res is not rescache.MISS:
+                    results[i] = res
             self._batch_pair_counts(idx, calls[:first_write], shards, results)
             self._batch_general(idx, calls[:first_write], shards, results)
             self._batch_bsi(idx, calls[:first_write], shards, results)
@@ -191,6 +213,13 @@ class Executor:
                 if results[i] is _UNSET:
                     with tracing.start_span(f"executor.execute{call.name}"):
                         results[i] = self._execute_call(idx, call, shards)
+            for i, call in enumerate(calls[:first_write]):
+                if tokens[i] is not None:
+                    self.rescache.store(
+                        tokens[i],
+                        results[i],
+                        recompute=self._maintained_recompute(idx, call, shards),
+                    )
             return [
                 self._translate_result(idx, c, r) for c, r in zip(q.calls, results)
             ]
@@ -247,6 +276,15 @@ class Executor:
                 shards = list(key) if key is not None else None
                 flat_calls = [c for qi in qis for c in cloned[qi]]
                 flat_results: list[Any] = [_UNSET] * len(flat_calls)
+                # cache probe before the flat batch passes: flight
+                # members served here never ride the device launch
+                flat_tokens: list[Any] = [None] * len(flat_calls)
+                for fi, call in enumerate(flat_calls):
+                    res, flat_tokens[fi] = self.rescache.lookup(
+                        idx, call, shards
+                    )
+                    if res is not rescache.MISS:
+                        flat_results[fi] = res
                 self._batch_pair_counts(idx, flat_calls, shards, flat_results)
                 self._batch_general(idx, flat_calls, shards, flat_results)
                 self._batch_bsi(idx, flat_calls, shards, flat_results)
@@ -254,6 +292,7 @@ class Executor:
                 for qi in qis:
                     calls = cloned[qi]
                     res = flat_results[pos:pos + len(calls)]
+                    toks = flat_tokens[pos:pos + len(calls)]
                     pos += len(calls)
                     try:
                         for ci, call in enumerate(calls):
@@ -264,6 +303,15 @@ class Executor:
                                     res[ci] = self._execute_call(
                                         idx, call, shards
                                     )
+                        for ci, call in enumerate(calls):
+                            if toks[ci] is not None:
+                                self.rescache.store(
+                                    toks[ci],
+                                    res[ci],
+                                    recompute=self._maintained_recompute(
+                                        idx, call, shards
+                                    ),
+                                )
                         out[qi] = [
                             self._translate_result(idx, c, r)
                             for c, r in zip(parsed[qi].calls, res)
@@ -271,6 +319,105 @@ class Executor:
                     except Exception as e:
                         out[qi] = e
         return out
+
+    def rescache_probe(
+        self,
+        index_name: str,
+        q: pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any] | None:
+        """All-or-nothing semantic cache probe for a whole parsed query:
+        the batcher calls this at submit time so a flight member whose
+        every call hits demuxes instantly instead of riding the device
+        launch (server/batcher.py).  Returns the translated result list,
+        or None when any call misses (the query then takes the normal
+        path — the probe counts no miss twice since lookup tokens are
+        discarded)."""
+        idx = self.holder.index(index_name)
+        if idx is None or not q.calls or q.write_calls():
+            return None
+        try:
+            results = []
+            for orig in q.calls:
+                call = orig.clone()
+                self._translate_call(idx, call)
+                res, _tok = self.rescache.lookup(idx, call, shards)
+                if res is rescache.MISS:
+                    return None
+                results.append(res)
+            return [
+                self._translate_result(idx, c, r)
+                for c, r in zip(q.calls, results)
+            ]
+        except Exception:
+            return None
+
+    def cached_execute_call(
+        self, idx: Index, call: Call, shards: list[int] | None
+    ) -> Any:
+        """One translated call through the semantic cache — the
+        distributed layer's per-owner partial path (cluster/dist.py):
+        local and mesh-facade partials cache under the owner's version
+        subvector, so a reduce over partials stays correct across
+        resize epochs (fragment epoch is part of the vector)."""
+        res, token = self.rescache.lookup(idx, call, shards)
+        if res is not rescache.MISS:
+            return res
+        out = self._execute_call(idx, call, shards)
+        if token is not None:
+            self.rescache.store(
+                token, out,
+                recompute=self._maintained_recompute(idx, call, shards),
+            )
+        return out
+
+    def _maintained_recompute(
+        self, idx: Index, call: Call, shards: list[int] | None
+    ):
+        """The promotion closure for hot TopN/GroupBy entries: re-derive
+        the result from the incrementally maintained per-fragment row
+        counts (``Fragment._counts``, carried through point writes and
+        imports in the same group-commit) instead of invalidating.
+        Unfiltered TopN re-merges the maintained counts host-side — no
+        device dispatch; GroupBy re-runs its aggregation over the same
+        maintained state.  Other call shapes don't promote (None)."""
+        if call.name == "TopN" and not call.children:
+            pass
+        elif call.name == "GroupBy" and "filter" not in call.args:
+            pass
+        else:
+            return None
+        frozen = call.clone()
+
+        def recompute():
+            return self._execute_call(idx, frozen.clone(), shards)
+
+        return recompute
+
+    def _after_write(self, idx: Index, call: Call, result: Any) -> Any:
+        self._note_write_call(idx, call)
+        return result
+
+    def _note_write_call(self, idx: Index, call: Call) -> None:
+        """Eager precise invalidation after a write call executed: drop
+        only the cache entries reading the written field.  Column-attr
+        writes have no field — they drop the index's entries (attrs are
+        outside the fragment version space, so the version vector can't
+        catch them)."""
+        name = call.name
+        if name == "SetColumnAttrs":
+            self.rescache.note_write(idx.name, None)
+            return
+        if name == "SetRowAttrs":
+            fname = call.args.get("_field")
+        else:
+            fname = call.field_arg()
+        if isinstance(fname, str):
+            self.rescache.note_write(idx.name, fname)
+            if idx.track_existence and name in ("Set", "Store"):
+                self.rescache.note_write(idx.name, "_exists")
+        else:
+            self.rescache.note_write(idx.name, None)
 
     # ----------------------------------------------- batched Count fast path
 
@@ -1369,19 +1516,27 @@ class Executor:
         if name == "MaxRow":
             return self._execute_min_max_row(idx, call, shards, maximal=True)
         if name == "Clear":
-            return self._execute_clear(idx, call)
+            return self._after_write(idx, call, self._execute_clear(idx, call))
         if name == "ClearRow":
-            return self._execute_clear_row(idx, call, shards)
+            return self._after_write(
+                idx, call, self._execute_clear_row(idx, call, shards)
+            )
         if name == "Store":
-            return self._execute_store(idx, call, shards)
+            return self._after_write(
+                idx, call, self._execute_store(idx, call, shards)
+            )
         if name == "Count":
             return self._execute_count(idx, call, shards)
         if name == "Set":
-            return self._execute_set(idx, call)
+            return self._after_write(idx, call, self._execute_set(idx, call))
         if name == "SetRowAttrs":
-            return self._execute_set_row_attrs(idx, call)
+            return self._after_write(
+                idx, call, self._execute_set_row_attrs(idx, call)
+            )
         if name == "SetColumnAttrs":
-            return self._execute_set_column_attrs(idx, call)
+            return self._after_write(
+                idx, call, self._execute_set_column_attrs(idx, call)
+            )
         if name == "TopN":
             return self._execute_topn(idx, call, shards)
         if name == "Rows":

@@ -410,6 +410,12 @@ class IngestPipeline:
         self.decoded = 0
         self.decode_seconds = 0.0
         self.segments = 0
+        # post-apply observer: called with the mutated fragment inside
+        # the same group-commit, before the upload stage sees it.  The
+        # API wires this to the semantic result cache so a write
+        # invalidates (or delta-maintains) entries the moment the merge
+        # lands, not when the next query's version probe notices.
+        self.on_apply = None
 
     def applies_active(self) -> int:
         with self._applies_lock:
@@ -459,6 +465,13 @@ class IngestPipeline:
                     for p in payloads:
                         release(p)
             self.pool.advance(applied=1)
+            if frag is not None and self.on_apply is not None:
+                try:
+                    self.on_apply(frag)
+                except Exception:
+                    # observers must never fail an ingest apply
+                    if self.stats is not None:
+                        self.stats.count("ingest_on_apply_errors", 1)
             if frag is not None and self.uploader is not None:
                 self.pool.note_phase("upload")
                 self.uploader.submit(frag)

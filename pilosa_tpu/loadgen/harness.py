@@ -52,7 +52,14 @@ class StageSpec:
     oversubscription knob: a stage whose working set exceeds the cap
     runs under live eviction pressure, and its report entry carries the
     residency hit/miss/prefetch rates observed while it ran
-    (docs/residency.md)."""
+    (docs/residency.md).
+
+    ``repeat_pool`` (template count) switches the stage's reads to the
+    repeat-heavy generator (``WorkloadGenerator.sequence_repeat``):
+    reads recur zipfian over that many fixed query templates while
+    writes keep randomizing — the dashboard-refresh shape that
+    exercises the semantic result cache, whose per-stage hit/
+    invalidation deltas land in the report entry (docs/caching.md)."""
 
     def __init__(
         self,
@@ -62,6 +69,7 @@ class StageSpec:
         workers: int,
         mix: dict[str, float] | None = None,
         device_budget: int | None = None,
+        repeat_pool: int | None = None,
     ):
         self.name = name
         self.duration = float(duration)
@@ -71,6 +79,7 @@ class StageSpec:
         self.device_budget = (
             int(device_budget) if device_budget is not None else None
         )
+        self.repeat_pool = int(repeat_pool) if repeat_pool else None
 
     @property
     def op_count(self) -> int:
@@ -84,6 +93,7 @@ class StageSpec:
             "workers": self.workers,
             "mix": self.mix,
             "deviceBudget": self.device_budget,
+            "repeatPool": self.repeat_pool,
         }
 
 
@@ -232,6 +242,33 @@ def _residency_delta(
     return delta
 
 
+def _rescache_counters(base: str) -> dict | None:
+    """Monotonic semantic-cache counters from /debug/vars, for per-stage
+    delta arithmetic (None when the node predates the cache plane)."""
+    dbg = _fetch_json(base, "/debug/vars")
+    if not dbg or "rescache" not in dbg:
+        return None
+    rc = dbg.get("rescache") or {}
+    batcher = dbg.get("batcher") or {}
+    return {
+        "hits": rc.get("hits", 0),
+        "misses": rc.get("misses", 0),
+        "invalidations": rc.get("invalidations", 0),
+        "promotions": rc.get("promotions", 0),
+        "maintainedHits": rc.get("maintainedHits", 0),
+        "rescacheDemux": batcher.get("rescacheDemux", 0),
+    }
+
+
+def _rescache_delta(before: dict | None, after: dict | None) -> dict | None:
+    if before is None or after is None:
+        return None
+    delta = {k: after[k] - before[k] for k in before}
+    lookups = delta["hits"] + delta["misses"]
+    delta["hitRate"] = delta["hits"] / lookups if lookups else None
+    return delta
+
+
 def _fetch_text(base: str, path: str) -> str:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
@@ -283,7 +320,16 @@ class LoadHarness:
         generator stream spans the stages so the whole run replays from
         the seed."""
         gen = WorkloadGenerator(self.config)
-        return [gen.sequence(st.op_count, st.mix) for st in self.stages]
+        return [
+            (
+                gen.sequence_repeat(
+                    st.op_count, st.mix, pool_size=st.repeat_pool
+                )
+                if st.repeat_pool
+                else gen.sequence(st.op_count, st.mix)
+            )
+            for st in self.stages
+        ]
 
     def run(self) -> dict:
         per_stage_ops = self.generate()
@@ -302,6 +348,7 @@ class LoadHarness:
             # (not configure) so entries admitted by earlier stages stay
             # accounted and the shrink evicts the live working set.
             res_before = _residency_counters(self.uris[0])
+            rc_before = _rescache_counters(self.uris[0])
             prev_cap: tuple | None = None
             if stage.device_budget is not None:
                 from pilosa_tpu.core import membudget
@@ -380,6 +427,9 @@ class LoadHarness:
                     "residency": _residency_delta(
                         res_before, _residency_counters(self.uris[0])
                     ),
+                    "rescache": _rescache_delta(
+                        rc_before, _rescache_counters(self.uris[0])
+                    ),
                 }
             )
         wall = time.monotonic() - t_run0
@@ -396,6 +446,9 @@ class LoadHarness:
                 "residency": final_vars.get("residency"),
                 "device": final_vars.get("device"),
             }
+        rescache = None
+        if final_vars and "rescache" in final_vars:
+            rescache = final_vars.get("rescache")
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -409,6 +462,7 @@ class LoadHarness:
             incidents=incidents,
             events=events,
             residency=residency,
+            rescache=rescache,
         )
 
 

@@ -36,6 +36,7 @@ def build_report(
     incidents: dict | None = None,
     events: dict | None = None,
     residency: dict | None = None,
+    rescache: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -104,6 +105,10 @@ def build_report(
         # the device hit/miss and prefetch useful/issued rates the
         # working-set manager sustained under eviction pressure
         "residency": residency,
+        # end-of-run semantic-cache snapshot (docs/caching.md); with a
+        # repeat-heavy stage in the plan, the per-stage entries carry
+        # the hit/invalidation deltas observed while it ran
+        "rescache": rescache,
         "verdicts": verdicts,
         "pass": overall,
     }

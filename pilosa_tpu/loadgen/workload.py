@@ -313,6 +313,51 @@ class WorkloadGenerator:
         choices = self._rng.choice(len(kinds), size=n, p=p)
         return [self._build(kinds[i]) for i in choices]
 
+    def sequence_repeat(
+        self,
+        n: int,
+        mix: dict[str, float] | None = None,
+        pool_size: int = 16,
+        pool_theta: float | None = None,
+    ) -> list[Op]:
+        """The next ``n`` ops with a repeat-heavy read side: reads are
+        drawn ZIPFIAN FROM A SMALL POOL of ``pool_size`` pre-built query
+        templates instead of being freshly randomized, so the same exact
+        queries recur the way dashboard refreshes do — the traffic shape
+        the semantic result cache (docs/caching.md) exists for.  Writes
+        (and every non-read kind) still randomize per-op from the mix,
+        so cache entries face live invalidation pressure.  Deterministic
+        like :meth:`sequence`: one rng stream drives the pool build, the
+        kind draws, and the pool picks."""
+        weights = dict(self.config.mix if mix is None else mix)
+        read_weights = {
+            k: w
+            for k, w in weights.items()
+            if OP_CLASS[k].startswith("read.") and w > 0
+        }
+        if not read_weights:
+            return self.sequence(n, mix)
+        # pool build advances the same stream (replays from the seed)
+        pool = self.sequence(max(1, int(pool_size)), read_weights)
+        pool_zipf = Zipf(
+            len(pool),
+            self.config.zipf_theta if pool_theta is None else pool_theta,
+        )
+        kinds = sorted(weights)
+        p = np.array([weights[k] for k in kinds], dtype=np.float64)
+        if p.sum() <= 0:
+            raise ValueError("mix weights must sum > 0")
+        p /= p.sum()
+        choices = self._rng.choice(len(kinds), size=n, p=p)
+        out: list[Op] = []
+        for i in choices:
+            kind = kinds[i]
+            if kind in read_weights:
+                out.append(pool[pool_zipf.sample(self._rng)])
+            else:
+                out.append(self._build(kind))
+        return out
+
 
 def schema_ops(config: WorkloadConfig) -> list[tuple[str, str, dict]]:
     """Schema the workload needs, as (kind, name, options) steps the

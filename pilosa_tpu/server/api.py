@@ -82,6 +82,9 @@ class API:
         max_writes_per_request: int | None = None,
         batch_window: float = 0.002,
         batch_max_size: int = 64,
+        rescache_entries: int = 512,
+        rescache_promote_hits: int = 3,
+        rescache_demote_deltas: int = 64,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -93,6 +96,9 @@ class API:
             self.holder,
             translator=translator,
             max_writes_per_request=max_writes_per_request,
+            rescache_entries=rescache_entries,
+            rescache_promote_hits=rescache_promote_hits,
+            rescache_demote_deltas=rescache_demote_deltas,
         )
         # Cluster-aware execution path (reference executor.go mapReduce);
         # collapses to the local executor on a single node.
@@ -136,6 +142,12 @@ class API:
             stats=self.holder.stats,
             staging_buffers=ingest_staging_buffers,
             upload_slots=ingest_upload_slots,
+        )
+        # Ingest applies invalidate (or delta-maintain) semantic-cache
+        # entries inside the same group-commit — version-precise, never
+        # a global flush (exec/rescache.py).
+        self.ingest.on_apply = lambda frag: self.executor.rescache.note_write(
+            frag.index, frag.field
         )
         # Continuous-batching serving plane (server/batcher.py):
         # concurrent read-only queries coalesce into micro-batched
@@ -1115,6 +1127,7 @@ class API:
                             counts_cached = frag._counts is not None
                             op_n = frag.op_n
                             mut_version = frag.version
+                            mut_epoch = frag.epoch
                             res_state = tracker.state_of(frag)
                             res_pinned = frag._res_pinned
                             res_heat = round(tracker.heat_of(frag), 3)
@@ -1135,7 +1148,12 @@ class API:
                             "deviceBytes": device_bytes,
                             "countsCached": counts_cached,
                             "opLogLength": op_n,
+                            # never resets (op_n rewinds on snapshot
+                            # load; version is monotonic for the life of
+                            # the fragment object, epoch fences rebuilt
+                            # objects) — the cache-correctness pair
                             "version": mut_version,
+                            "epoch": mut_epoch,
                             "residency": res_state,
                             "pinned": res_pinned,
                             "heat": res_heat,
@@ -1151,6 +1169,7 @@ class API:
             "deviceResident": sum(1 for f in fragments if f["deviceResident"]),
             "deviceBytes": sum(f["deviceBytes"] for f in fragments),
             "opLogLength": sum(f["opLogLength"] for f in fragments),
+            "version": sum(f["version"] for f in fragments),
             "pinned": sum(1 for f in fragments if f["pinned"]),
             "staging": sum(
                 1 for f in fragments if f["residency"] == residency.STATE_STAGING

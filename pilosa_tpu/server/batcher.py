@@ -130,6 +130,7 @@ class QueryBatcher:
         self._depth_peak = 0  # high-water mark since last take_depth_peak
         self.dispatched = 0  # flights dispatched (observability)
         self.coalesced = 0  # requests that shared a flight with >=1 other
+        self.rescache_demux = 0  # members served from the semantic cache
         self._thread = threading.Thread(
             target=self._run, name="query-batcher", daemon=True
         )
@@ -153,6 +154,19 @@ class QueryBatcher:
             if self.stats is not None:
                 self.stats.count("batcher_deadline_bypass", 1, 1.0)
             return self.executor.execute(index, query, shards=shards)
+        # Semantic cache probe (exec/rescache.py): a member whose every
+        # call hits demuxes instantly — no flight, no queue wait, no
+        # device launch.  The probe runs on the handler thread with the
+        # profile context live, so ?profile=true carries the
+        # rescache.lookup span.
+        probe = getattr(self.executor, "rescache_probe", None)
+        if probe is not None:
+            cached = probe(index, query, shards)
+            if cached is not None:
+                self.rescache_demux += 1
+                if self.stats is not None:
+                    self.stats.count("batcher_rescache_demux", 1, 1.0)
+                return cached
         if self.prefetcher is not None:
             try:
                 # stage this query's cold fragments NOW (handler thread,
@@ -363,6 +377,7 @@ class QueryBatcher:
             "maxBatch": self.max_batch,
             "batches": self.dispatched,
             "coalesced": self.coalesced,
+            "rescacheDemux": self.rescache_demux,
         }
 
     def close(self) -> None:

@@ -337,6 +337,10 @@ class Handler(BaseHTTPRequestHandler):
                 "stack_incremental": ex.stack_incremental,
                 "bsi_stack_launches": ex.bsi_stack_launches,
             }
+            # semantic result cache: hit/miss/invalidation counters plus
+            # promotion state of the maintained TopN/GroupBy views
+            # (exec/rescache.py)
+            snap["rescache"] = ex.rescache.snapshot()
         from pilosa_tpu.core import membudget, residency, translate
         from pilosa_tpu.ops import kernels
 

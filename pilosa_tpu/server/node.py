@@ -174,6 +174,9 @@ class NodeServer:
         slow_query_time: float = 0.0,
         batch_window: float = 0.002,
         batch_max_size: int = 64,
+        rescache_entries: int = 512,
+        rescache_promote_hits: int = 3,
+        rescache_demote_deltas: int = 64,
         slo_objectives: dict | None = None,
         slo_burn_rules: list[dict] | None = None,
         slo_slot_seconds: float | None = None,
@@ -299,6 +302,9 @@ class NodeServer:
             max_writes_per_request=max_writes_per_request,
             batch_window=batch_window,
             batch_max_size=batch_max_size,
+            rescache_entries=rescache_entries,
+            rescache_promote_hits=rescache_promote_hits,
+            rescache_demote_deltas=rescache_demote_deltas,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

@@ -44,6 +44,9 @@ class InProcessCluster:
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
         mesh_dispatch: bool = True,
+        rescache_entries: int = 512,
+        rescache_promote_hits: int = 3,
+        rescache_demote_deltas: int = 64,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -69,6 +72,9 @@ class InProcessCluster:
             "flightrec_sample_interval": flightrec_sample_interval,
             "flightrec_segments": flightrec_segments,
             "flightrec_spike_504": flightrec_spike_504,
+            "rescache_entries": rescache_entries,
+            "rescache_promote_hits": rescache_promote_hits,
+            "rescache_demote_deltas": rescache_demote_deltas,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

@@ -17,7 +17,10 @@ def setup():
     idx = h.create_index("i", track_existence=True)
     idx.create_field("f")
     idx.create_field("g")
-    ex = Executor(h)
+    # rescache off: this file asserts the batch-compile layer's launch
+    # accounting on repeat queries, which the semantic result cache
+    # would otherwise short-circuit (it has its own tests)
+    ex = Executor(h, rescache_entries=0)
     rng = np.random.default_rng(9)
     writes = []
     pool = rng.integers(0, 3 * h.n_words * 32, size=150)

@@ -308,7 +308,9 @@ class TestBSIAggServing:
         idx.create_field(
             "v", FieldOptions(field_type="int", min_=-500, max_=500)
         )
-        ex = Executor(h)
+        # rescache off: the class asserts scalar-cache hits on repeats,
+        # which the semantic result cache would serve first
+        ex = Executor(h, rescache_entries=0)
         rng = np.random.default_rng(23)
         self.vals = {}
         width = h.n_words * 32
@@ -370,7 +372,8 @@ class TestRangeCountServing:
         idx.create_field(
             "v", FieldOptions(field_type="int", min_=-300, max_=300)
         )
-        ex = Executor(h)
+        # rescache off: same scalar-cache accounting as TestBSIAggServing
+        ex = Executor(h, rescache_entries=0)
         ex._BSI_SINGLE_WARM = 0  # assert stacked serving from query 1
         rng = np.random.default_rng(31)
         self.vals = {}

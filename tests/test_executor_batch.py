@@ -15,7 +15,9 @@ def setup():
     idx = h.create_index("i")
     idx.create_field("f")
     idx.create_field("g")
-    ex = Executor(h)
+    # rescache off: this file asserts gram/cross-gram serving-cache
+    # behavior on repeats, below the semantic result cache
+    ex = Executor(h, rescache_entries=0)
     rng = np.random.default_rng(4)
     writes = []
     # f and g draw columns from a shared pool so cross-field intersections

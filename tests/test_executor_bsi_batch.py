@@ -37,7 +37,9 @@ def setup():
         "v", FieldOptions(field_type="int", min_=-1000, max_=1000)
     )
     idx.create_field("seg")
-    ex = Executor(h)
+    # rescache off: this file asserts BSI launch/agg-cache accounting on
+    # repeats, below the semantic result cache
+    ex = Executor(h, rescache_entries=0)
     rng = np.random.default_rng(9)
     writes = []
     for c in rng.choice(40_000, size=600, replace=False):

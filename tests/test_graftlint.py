@@ -26,6 +26,7 @@ PER_FILE = [
     "log_discipline",
     "queue_discipline",
     "residency_discipline",
+    "cache_discipline",
 ]
 
 
@@ -124,6 +125,21 @@ class TestBadCorpusCoverage:
         assert not p.applies("pilosa_tpu/core/fragment.py")
         assert p.applies("pilosa_tpu/exec/executor.py")
         assert p.applies("tests/test_residency.py")
+
+    def test_cache_classes(self):
+        findings = _check_corpus_file("cache_discipline", "bad")
+        msgs = " | ".join(f.message for f in findings)
+        # private-state pokes (entry map, reverse map, lock) + both
+        # counter-write forms (augmented and plain) all fire
+        assert len(findings) == 5
+        assert "private ResultCache state" in msgs
+        assert "hand-written ResultCache counter" in msgs
+
+    def test_cache_owner_itself_exempt(self):
+        p = BY_ID["cache-discipline"]
+        assert not p.applies("pilosa_tpu/exec/rescache.py")
+        assert p.applies("pilosa_tpu/exec/executor.py")
+        assert p.applies("tests/test_rescache.py")
 
 
 class TestDispatchParity:

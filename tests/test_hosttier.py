@@ -191,7 +191,9 @@ class TestBSIHostTier:
         idx.create_field(
             "v", FieldOptions(field_type="int", min_=-500, max_=500)
         )
-        ex = Executor(h)
+        # rescache off: warm-promotion counts repeat demand per query,
+        # and a result-cache hit would never reach the warm counter
+        ex = Executor(h, rescache_entries=0)
         rng = np.random.default_rng(23)
         vals = {}
         width = h.n_words * 32

@@ -164,6 +164,41 @@ def test_default_stage_plan_has_oversubscribed_stage():
     assert sum(s.duration for s in stages) == pytest.approx(10.0)
 
 
+def test_repeat_sequence_repeats_reads_and_replays():
+    cfg = WorkloadConfig(seed=11)
+    a = WorkloadGenerator(cfg).sequence_repeat(400, pool_size=8)
+    b = WorkloadGenerator(cfg).sequence_repeat(400, pool_size=8)
+    assert fingerprint(a) == fingerprint(b)  # seed-deterministic
+    reads = [op for op in a if op.op_class.startswith("read.")]
+    writes = [op for op in a if op.op_class == "write"]
+    assert reads and writes
+    # the read side recurs over <= pool_size distinct queries; zipfian
+    # skew makes the hottest template dominate
+    bodies = [op.body for op in reads]
+    distinct = set(bodies)
+    assert len(distinct) <= 8
+    hottest = max(distinct, key=bodies.count)
+    assert bodies.count(hottest) / len(bodies) > 0.3
+    # writes keep randomizing (far more distinct than the pool)
+    assert len({op.body for op in writes}) > 8
+
+
+def test_default_stage_plan_has_repeatread_stage():
+    from tools.loadharness import REPEAT_POOL, REPEAT_READ_MIX, default_stages
+
+    stages = default_stages(duration=12.0, rate=100.0, workers=4)
+    [rr] = [s for s in stages if s.name == "repeatread"]
+    assert rr.mix is REPEAT_READ_MIX
+    assert rr.repeat_pool == REPEAT_POOL > 0
+    assert rr.to_dict()["repeatPool"] == REPEAT_POOL
+    # repeat-heavy reads dominate, with write pressure interleaved so
+    # cache invalidation stays live during the stage
+    assert max(REPEAT_READ_MIX, key=REPEAT_READ_MIX.get) == "count"
+    assert REPEAT_READ_MIX["set"] > 0
+    # the surrounding stages stay on the fresh-randomized generator
+    assert all(s.repeat_pool is None for s in stages if s.name != "repeatread")
+
+
 def test_time_quantum_ops_carry_timestamps():
     g = WorkloadGenerator(WorkloadConfig(seed=2))
     ops = g.sequence(50, mix={"set_tq": 1.0, "range_time": 1.0})

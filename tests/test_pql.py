@@ -207,3 +207,59 @@ def test_clone_independent():
     d.children[0].args["x"] = 1
     assert c.args["limit"] == 5
     assert "x" not in c.children[0].args
+
+
+# -- serialization determinism (the semantic result cache keys on it) --------
+
+
+def _random_call(rng, depth=0):
+    """Random query tree over the grammar's cacheable read shapes."""
+    leaf = depth >= 2 or rng.random() < 0.4
+    if leaf:
+        field = rng.choice("abc")
+        return Call("Row", {field: rng.randrange(8)}, [])
+    name = rng.choice(["Intersect", "Union", "Xor", "Difference", "Not", "Count"])
+    n = 1 if name in ("Not", "Count") else rng.randrange(2, 4)
+    children = [_random_call(rng, depth + 1) for _ in range(n)]
+    args = {}
+    if rng.random() < 0.3:
+        # args deliberately inserted in random order
+        pairs = [("limit", rng.randrange(100)), ("zz", rng.randrange(9))]
+        rng.shuffle(pairs)
+        args = dict(pairs)
+    return Call(name, args, children)
+
+
+def test_str_roundtrip_property():
+    """str() -> parse() -> str() is a fixed point for random trees, so a
+    stringified query is a stable cache key."""
+    import random
+
+    rng = random.Random(20260805)
+    for _ in range(200):
+        c = _random_call(rng)
+        s1 = str(c)
+        reparsed = pql.parse(s1).calls[0]
+        assert reparsed == c
+        assert str(reparsed) == s1
+
+
+def test_str_arg_order_deterministic():
+    """Stringification is insertion-order independent (sorted args)."""
+    a = Call("TopN", {"_field": "f", "n": 5, "filter": Call("Row", {"a": 1}, [])}, [])
+    b = Call("TopN", {"filter": Call("Row", {"a": 1}, []), "n": 5, "_field": "f"}, [])
+    assert str(a) == str(b)
+    assert pql.parse(str(a)).calls[0] == pql.parse(str(b)).calls[0]
+
+
+def test_canonical_str_sorts_commutative_children():
+    from pilosa_tpu.exec import rescache
+
+    a = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    b = one("Count(Intersect(Row(b=2), Row(a=1)))")
+    assert str(a) != str(b)  # surface order is preserved...
+    assert rescache.canonical_str(a) == rescache.canonical_str(b)  # ...keys unify
+    # non-commutative order must NOT unify
+    c = one("Count(Difference(Row(a=1), Row(b=2)))")
+    d = one("Count(Difference(Row(b=2), Row(a=1)))")
+    assert rescache.canonical_str(c) != rescache.canonical_str(d)

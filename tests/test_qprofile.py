@@ -197,9 +197,18 @@ def test_slow_query_log_captures_faulted_fanout():
         rs = _remote_shard(cl, "i")
         remote_node = cl.nodes.index(cl.owner_of("i", rs))
         cl.import_bits("i", "f", [(0, 0), (0, rs * SHARD_WIDTH + 5)])
-        # fast query first: must NOT land in the log
+        # fast queries must NOT land in the log — but the first couple
+        # of distributed Counts also pay one-time jit compilation, which
+        # on a cold process can cross the 50 ms bar on its own; warm
+        # until that is paid, then assert the warm fast path stays out
+        # of the log
+        for _ in range(3):
+            cl.query(0, "i", "Count(Row(f=0))")
+        base_count = cl.nodes[0].api.slow_queries.snapshot()["count"]
         cl.query(0, "i", "Count(Row(f=0))")
-        assert cl.nodes[0].api.slow_queries.snapshot()["count"] == 0
+        assert (
+            cl.nodes[0].api.slow_queries.snapshot()["count"] == base_count
+        )
         # stall the coordinator->owner hop past the threshold
         cl.inject_fault("slow", node=remote_node, delay=0.2)
         cl.query(0, "i", "Count(Row(f=0))")

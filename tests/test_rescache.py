@@ -1,0 +1,316 @@
+"""Semantic result cache (exec/rescache.py) — unit behavior plus the
+property that matters: the cache is INVISIBLE.  A cached executor and an
+uncached executor over the same holder must return bit-identical results
+for randomized read streams interleaved with writes, across snapshot
+compaction and mid-traffic cluster resize."""
+
+import random
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import rescache
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+SEED = 20260805
+
+
+@pytest.fixture()
+def ex():
+    h = Holder()
+    h.create_index("i")
+    return Executor(h)
+
+
+def _norm(results):
+    return [result_to_json(r) for r in results]
+
+
+class TestCacheUnit:
+    def test_hit_and_counters(self, ex):
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=1) Set(2, f=1)")
+        a = ex.execute("i", "Count(Row(f=1))")
+        b = ex.execute("i", "Count(Row(f=1))")
+        assert a == b == [2]
+        snap = ex.rescache.snapshot()
+        assert snap["hits"] >= 1 and snap["stores"] >= 1
+
+    def test_write_invalidates_precisely(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("f")
+        idx.create_field("g")
+        ex.execute("i", "Set(1, f=1) Set(1, g=1)")
+        ex.execute("i", "Count(Row(f=1))")
+        ex.execute("i", "Count(Row(g=1))")
+        entries_before = ex.rescache.snapshot()["entries"]
+        assert entries_before >= 2
+        # a write to g must drop only g's entry; f's entry keeps serving
+        ex.execute("i", "Set(2, g=1)")
+        assert ex.execute("i", "Count(Row(g=1))") == [2]
+        ex.execute("i", "Count(Row(f=1))")
+        snap = ex.rescache.snapshot()
+        assert snap["invalidations"] >= 1
+        # f's re-query was a hit (entry survived the g write)
+        assert snap["hits"] >= 1
+
+    def test_writes_never_served_from_cache(self, ex):
+        ex.holder.index("i").create_field("f")
+        assert ex.execute("i", "Set(1, f=1)") == [True]
+        assert ex.execute("i", "Set(1, f=1)") == [False]  # not cached [True]
+
+    def test_commutative_queries_share_entry(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("a")
+        idx.create_field("b")
+        ex.execute("i", "Set(1, a=1) Set(1, b=2) Set(2, a=1)")
+        ex.execute("i", "Count(Intersect(Row(a=1), Row(b=2)))")
+        before = ex.rescache.snapshot()["hits"]
+        ex.execute("i", "Count(Intersect(Row(b=2), Row(a=1)))")
+        assert ex.rescache.snapshot()["hits"] == before + 1
+
+    def test_row_attr_queries_not_poisoned(self, ex):
+        """SetRowAttrs doesn't bump fragment versions; eager note_write
+        must still keep TopN-with-attrs correct by never caching it."""
+        ex.holder.index("i").create_field("f")
+        ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=2)")
+        q = 'TopN(f, attrName="cat", attrValues=["x"])'
+        assert _norm(ex.execute("i", q)) == _norm(ex.execute("i", q))
+        ex.execute("i", 'SetRowAttrs(f, 1, cat="x")')
+        got = result_to_json(ex.execute("i", q)[0])
+        assert [p["id"] for p in got] == [1]
+
+    def test_recreated_index_no_aliasing(self):
+        h = Holder()
+        h.create_index("i").create_field("f")
+        ex = Executor(h)
+        ex.execute("i", "Set(1, f=1)")
+        assert ex.execute("i", "Count(Row(f=1))") == [1]
+        h.delete_index("i")
+        h.create_index("i").create_field("f")
+        # same name, fresh index: must recompute, not alias old entry
+        assert ex.execute("i", "Count(Row(f=1))") == [0]
+
+    def test_schema_change_rotates_keys(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("f")
+        ex.execute("i", "Set(1, f=1)")
+        ex.execute("i", "Count(Row(f=1))")
+        gen = idx.generation
+        idx.create_field("h")
+        assert idx.generation == gen + 1
+        # entry keyed under the old generation: next probe is a miss
+        misses = ex.rescache.snapshot()["misses"]
+        assert ex.execute("i", "Count(Row(f=1))") == [1]
+        assert ex.rescache.snapshot()["misses"] == misses + 1
+
+    def test_lru_eviction(self):
+        h = Holder()
+        h.create_index("i").create_field("f")
+        ex = Executor(h, rescache_entries=2)
+        ex.execute("i", "Set(1, f=1) Set(1, f=2) Set(1, f=3)")
+        for r in (1, 2, 3):
+            ex.execute("i", f"Count(Row(f={r}))")
+        snap = ex.rescache.snapshot()
+        assert snap["entries"] == 2 and snap["evictions"] >= 1
+
+    def test_promotion_and_maintained_refresh(self):
+        h = Holder()
+        h.create_index("i").create_field("f")
+        ex = Executor(h, rescache_promote_hits=2)
+        ex.execute("i", "Set(1, f=1) Set(2, f=1) Set(3, f=2)")
+        for _ in range(4):
+            ex.execute("i", "TopN(f)")
+        assert ex.rescache.snapshot()["promotions"] >= 1
+        # a write refreshes the maintained view in place, not a drop
+        ex.execute("i", "Set(4, f=2) Set(5, f=2)")
+        got = result_to_json(ex.execute("i", "TopN(f)")[0])
+        assert [(p["id"], p["count"]) for p in got] == [(2, 3), (1, 2)]
+        assert ex.rescache.snapshot()["maintainedHits"] >= 1
+
+    def test_demotion_after_delta_budget(self):
+        h = Holder()
+        h.create_index("i").create_field("f")
+        ex = Executor(h, rescache_promote_hits=1, rescache_demote_deltas=2)
+        ex.execute("i", "Set(1, f=1) Set(2, f=2)")
+        for _ in range(3):
+            ex.execute("i", "TopN(f)")
+        assert ex.rescache.snapshot()["promotions"] >= 1
+        # hammer writes past the delta budget -> demote back to plain
+        for c in range(10, 40):
+            ex.execute("i", f"Set({c}, f=1)")
+            ex.execute("i", "TopN(f)")
+        snap = ex.rescache.snapshot()
+        assert snap["demotions"] >= 1
+        got = result_to_json(ex.execute("i", "TopN(f)")[0])
+        assert got[0]["id"] == 1 and got[0]["count"] == 31
+
+
+# -- randomized equivalence: cached executor vs uncached twin ----------------
+
+
+FIELDS = ("a", "b")
+INT_FIELD = "v"
+
+
+def _seed_holder():
+    h = Holder()
+    idx = h.create_index("i")
+    for f in FIELDS:
+        idx.create_field(f)
+    idx.create_field(INT_FIELD, FieldOptions(field_type="int", min_=0, max_=1000))
+    return h
+
+
+def _random_read(rng):
+    f = rng.choice(FIELDS)
+    g = rng.choice(FIELDS)
+    r, s = rng.randrange(4), rng.randrange(4)
+    return rng.choice(
+        [
+            f"Row({f}={r})",
+            f"Count(Row({f}={r}))",
+            f"Count(Intersect(Row({f}={r}), Row({g}={s})))",
+            f"Count(Union(Row({f}={r}), Row({g}={s})))",
+            f"TopN({f})",
+            f"TopN({f}, n=2)",
+            f"GroupBy(Rows({f}))",
+            f"GroupBy(Rows({f}), Rows({g}))",
+            f"Row({INT_FIELD} > {rng.randrange(500)})",
+            f"Count(Row({INT_FIELD} < {rng.randrange(500)}))",
+            f"Min(field={INT_FIELD})",
+            f"Max(field={INT_FIELD})",
+            f"Sum(field={INT_FIELD})",
+        ]
+    )
+
+
+def _random_write(rng):
+    col = rng.randrange(3) * SHARD_WIDTH + rng.randrange(64)
+    if rng.random() < 0.25:
+        return f"Set({col}, {INT_FIELD}={rng.randrange(1000)})"
+    f = rng.choice(FIELDS)
+    r = rng.randrange(4)
+    if rng.random() < 0.2:
+        return f"Clear({col}, {f}={r})"
+    return f"Set({col}, {f}={r})"
+
+
+def test_cached_equals_uncached_interleaved():
+    """300 random ops through a cached executor; every read re-executed
+    on an uncached twin over the SAME holder must match exactly."""
+    h = _seed_holder()
+    cached = Executor(h)
+    uncached = Executor(h, rescache_entries=0)
+    rng = random.Random(SEED)
+    for step in range(300):
+        if rng.random() < 0.3:
+            q = _random_write(rng)
+            cached.execute("i", q)
+            continue
+        q = _random_read(rng)
+        got = _norm(cached.execute("i", q))
+        want = _norm(uncached.execute("i", q))
+        assert got == want, f"seed={SEED} step={step} q={q}"
+    snap = cached.rescache.snapshot()
+    assert snap["hits"] > 0 and snap["invalidations"] > 0
+
+
+def test_cached_equals_uncached_across_snapshot(tmp_path):
+    """Snapshot compaction rewinds op_n but not version/epoch — entries
+    keyed before a compact must stay correct after it."""
+    from pilosa_tpu.storage.disk import HolderStore
+
+    h = Holder()
+    store = HolderStore(h, str(tmp_path))
+    store.open()
+    idx = h.create_index("i")
+    for f in FIELDS:
+        idx.create_field(f)
+    idx.create_field(INT_FIELD, FieldOptions(field_type="int", min_=0, max_=1000))
+
+    def compact_all():
+        # force every fragment's op log through snapshot compaction
+        # (op_n rewinds; version/epoch must not)
+        for i in h.indexes.values():
+            for fld in i.fields.values():
+                for view in fld.views.values():
+                    for frag in view.fragments.values():
+                        if frag.store is not None:
+                            frag.store.snapshot()
+
+    cached = Executor(h)
+    uncached = Executor(h, rescache_entries=0)
+    rng = random.Random(SEED + 1)
+    for step in range(120):
+        if rng.random() < 0.3:
+            cached.execute("i", _random_write(rng))
+            continue
+        if step and step % 40 == 0:
+            compact_all()
+        q = _random_read(rng)
+        assert _norm(cached.execute("i", q)) == _norm(uncached.execute("i", q)), (
+            f"seed={SEED + 1} step={step} q={q}"
+        )
+    store.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh", [True, False], ids=["mesh", "http"])
+def test_cluster_cached_equals_model_with_resize(mesh):
+    """Randomized reads against a live cluster (every node answers the
+    same), interleaved with writes and a mid-traffic resize; ground
+    truth is a pure-python model."""
+    from pilosa_tpu.testing.cluster import InProcessCluster
+
+    rng = random.Random(SEED + 2)
+    rows: dict[str, dict[int, set]] = {f: {} for f in FIELDS}
+    with InProcessCluster(2, mesh_dispatch=mesh) as cl:
+        cl.create_index("i")
+        for f in FIELDS:
+            cl.create_field("i", f)
+
+        def write():
+            f = rng.choice(FIELDS)
+            r = rng.randrange(3)
+            col = rng.randrange(3) * SHARD_WIDTH + rng.randrange(32)
+            cl.query(rng.randrange(len(cl.nodes)), "i", f"Set({col}, {f}={r})")
+            rows[f].setdefault(r, set()).add(col)
+
+        def check(step):
+            f = rng.choice(FIELDS)
+            r = rng.randrange(3)
+            node = rng.randrange(len(cl.nodes))
+            got = cl.query(node, "i", f"Count(Row({f}={r}))")["results"][0]
+            want = len(rows[f].get(r, set()))
+            assert got == want, f"step={step} node={node} {f}={r}"
+            got_topn = cl.query(node, "i", f"TopN({f})")["results"][0]
+            want_counts = sorted(
+                ((len(cs), -rid) for rid, cs in rows[f].items() if cs),
+                reverse=True,
+            )
+            assert [(p["count"], -p["id"]) for p in got_topn] == want_counts, (
+                f"step={step} node={node} TopN({f})"
+            )
+
+        for _ in range(12):
+            write()
+        for step in range(60):
+            if rng.random() < 0.35:
+                write()
+            else:
+                check(step)
+            if step == 30:
+                cl.add_node()  # mid-traffic resize: epochs fence old entries
+        # mesh dispatch books partial hits in the facade executors'
+        # caches; the HTTP path in each node's local executor cache
+        hits = sum(
+            n.api.executor.rescache.snapshot()["hits"] for n in cl.nodes
+        ) + sum(
+            n.api.dist.snapshot()["meshRescache"]["hits"] for n in cl.nodes
+        )
+        assert hits > 0

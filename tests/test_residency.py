@@ -286,7 +286,9 @@ def test_prefetcher_noops_when_budget_uncapped(clean_residency):
 def test_prefetcher_stages_and_scores_useful_under_cap(clean_residency):
     from pilosa_tpu.server.api import API
 
-    api = API(batch_window=0.003, batch_max_size=32)
+    # rescache off: the usefulness score needs the repeat query to reach
+    # the device, not the semantic result cache
+    api = API(batch_window=0.003, batch_max_size=32, rescache_entries=0)
     try:
         api.create_index("i")
         rng = np.random.default_rng(9)

@@ -120,7 +120,9 @@ def test_executor_query_counts():
 def test_http_metrics_and_debug_vars(tmp_path):
     from pilosa_tpu.server.node import NodeServer
 
-    node = NodeServer(port=0)
+    # rescache off: the test asserts gram-cache counters move on repeat
+    # queries, which the semantic result cache would serve first
+    node = NodeServer(port=0, rescache_entries=0)
     node.start()
     try:
         base = node.uri

@@ -1,0 +1,26 @@
+"""cache-discipline bad corpus."""
+
+
+def drop_entry_by_hand(executor, key):
+    # the by-field reverse map still points at the key: the next
+    # note_write double-drops (or, worse, skips a live entry)
+    executor.rescache._entries.pop(key, None)
+
+
+def read_reverse_map(api, index, field):
+    # unlocked read of cache internals
+    return api.executor.rescache._by_field.get((index, field))
+
+
+def fake_a_hit(node):
+    # operator surfaces now report a hit the cache never served
+    node.api.executor.rescache.hits += 1
+
+
+def zero_counters(ex):
+    ex.rescache.invalidations = 0
+
+
+def grab_lock(ex):
+    with ex.rescache._lock:
+        pass

@@ -1,0 +1,31 @@
+"""cache-discipline good corpus: drive the cache through its protocol."""
+
+
+def probe(ex, idx, call, shards):
+    from pilosa_tpu.exec import rescache
+
+    res, token = ex.rescache.lookup(idx, call, shards)
+    if res is not rescache.MISS:
+        return res
+    return token
+
+
+def invalidate(api, frag):
+    api.executor.rescache.note_write(frag.index, frag.field)
+
+
+def observe(ex):
+    # snapshot() and the public counters are readable everywhere
+    snap = ex.rescache.snapshot()
+    return snap["hits"], ex.rescache.hits
+
+
+def cold_cache_for_test(holder):
+    from pilosa_tpu.exec.executor import Executor
+
+    # a test that wants no caching says so at construction
+    return Executor(holder, rescache_entries=0)
+
+
+def unrelated_private(obj):
+    return obj.other._entries  # not a rescache receiver

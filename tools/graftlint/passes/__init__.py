@@ -2,6 +2,7 @@
 (docs/graftlint.md walks through it)."""
 
 from tools.graftlint.passes import (
+    cache_discipline,
     dispatch_parity,
     dtype_discipline,
     durability,
@@ -27,6 +28,7 @@ ALL_PASSES = [
     log_discipline,
     queue_discipline,
     residency_discipline,
+    cache_discipline,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

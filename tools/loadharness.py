@@ -14,6 +14,10 @@ Default stage plan (scaled by --duration/--rate/--workers):
                    HBM budget (stage-scoped ``device_budget``), so the
                    report carries residency hit/miss and prefetch
                    useful/issued rates under live eviction pressure
+    repeatread     repeat-heavy reads drawn zipfian over a small query
+                   template pool with interleaved writes — the semantic
+                   result cache lane; the report entry carries the
+                   stage's cache hit/invalidation deltas
     ramp           full mix at full rate and concurrency (budget restored)
 
 Examples::
@@ -75,6 +79,15 @@ OVERSUB_MIX = {
     "count": 40.0, "range_bsi": 20.0, "row": 12.0, "groupby": 8.0,
     "topn": 6.0, "set": 8.0, "translate": 6.0,
 }
+# Repeat-heavy: the dashboard-refresh shape — reads recur zipfian over a
+# small fixed template pool (StageSpec.repeat_pool) so the semantic
+# result cache sees real repeat traffic, while ~12% writes keep
+# version-precise invalidation live (docs/caching.md).
+REPEAT_READ_MIX = {
+    "count": 38.0, "topn": 16.0, "groupby": 12.0, "row": 12.0,
+    "range_bsi": 10.0, "set": 8.0, "set_val": 4.0,
+}
+REPEAT_POOL = 12
 
 
 def oversub_budget() -> int:
@@ -93,16 +106,20 @@ def oversub_budget() -> int:
 
 
 def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
-    fifth = max(1.0, duration / 5.0)
+    sixth = max(1.0, duration / 6.0)
     return [
-        StageSpec("warm", fifth, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
-        StageSpec("timequantum", fifth, rate, workers, TIMEQUANTUM_MIX),
-        StageSpec("rangescan", fifth, rate, workers, RANGE_HEAVY_MIX),
+        StageSpec("warm", sixth, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", sixth, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("rangescan", sixth, rate, workers, RANGE_HEAVY_MIX),
         StageSpec(
-            "oversubscribed", fifth, rate, workers, OVERSUB_MIX,
+            "oversubscribed", sixth, rate, workers, OVERSUB_MIX,
             device_budget=oversub_budget(),
         ),
-        StageSpec("ramp", fifth, rate * 1.5, workers, None),
+        StageSpec(
+            "repeatread", sixth, rate, workers, REPEAT_READ_MIX,
+            repeat_pool=REPEAT_POOL,
+        ),
+        StageSpec("ramp", sixth, rate * 1.5, workers, None),
     ]
 
 
@@ -184,7 +201,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.print_sequence:
         gen = WorkloadGenerator(config)
         for st in stages:
-            for op in gen.sequence(st.op_count, st.mix):
+            ops = (
+                gen.sequence_repeat(st.op_count, st.mix, pool_size=st.repeat_pool)
+                if st.repeat_pool
+                else gen.sequence(st.op_count, st.mix)
+            )
+            for op in ops:
                 print(json.dumps({"stage": st.name, **op.to_wire()}))
         return 0
 
@@ -231,6 +253,13 @@ def main(argv: list[str] | None = None) -> int:
             ) + (
                 f" prefetchUseful={uf:.3f}" if uf is not None else ""
             ) + f" evictions={res.get('evictions', 0)}"
+        rc = st.get("rescache")
+        if rc and st.get("repeatPool"):
+            chr_ = rc.get("hitRate")
+            res_note += (
+                f" cacheHitRate={chr_:.3f}" if chr_ is not None
+                else " cacheHitRate=n/a"
+            ) + f" cacheInval={rc.get('invalidations', 0)}"
         print(
             f"  stage {st['name']:<14} avail={st['availability']:.4f} "
             f"{'OK' if st['availabilityOk'] else 'LOW'}"

@@ -151,8 +151,20 @@ def main() -> int:
         assert snap, "no batcher block in /debug/vars"
         assert snap["batches"] >= 1 and snap["depth"] == 0, snap
 
+        # the burst repeated Count(Row(f=1)) 160x, so the semantic result
+        # cache now serves it before any flight forms — the repeat
+        # profiles as a rescache.lookup hit, while a never-seen query
+        # still rides the batcher and profiles its flight spans
         resp = json.loads(
             _post(f"{base}/index/smoke/query?profile=true", b"Count(Row(f=1))")
+        )
+        names = [c["name"] for c in resp["profile"]["tree"]["children"]]
+        assert "rescache.lookup" in names, names
+        resp = json.loads(
+            _post(
+                f"{base}/index/smoke/query?profile=true",
+                b"Count(Union(Row(f=1), Row(f=7)))",
+            )
         )
         names = [c["name"] for c in resp["profile"]["tree"]["children"]]
         assert "batcher.queueWait" in names, names

@@ -1,0 +1,145 @@
+"""CI smoke check for the semantic result cache (docs/caching.md).
+
+Boots one real NodeServer and drives it over actual HTTP through the
+cache's whole life cycle:
+
+* a repeated query **hits** (second arrival served from the cache,
+  identical payload);
+* a **targeted write invalidates precisely** — the written field's
+  entry drops, a sibling field's entry keeps serving (hit count still
+  climbs across the write);
+* a hot unfiltered TopN **promotes** to a maintained view and reads
+  back the correct post-write counts through in-place refresh instead
+  of invalidation;
+* the operator surfaces carry it: ``pilosa_rescache_*`` series in
+  ``/metrics``, the ``rescache`` block in ``/debug/vars``, the
+  ``rescache.lookup`` span under ``?profile=true``, and per-fragment
+  ``version``/``epoch`` in ``/debug/fragments``.
+
+Exit status 0 on success; any assertion/exception fails the CI step.
+Run as ``python -m tools.smoke_rescache``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def _get(uri: str) -> bytes:
+    return urllib.request.urlopen(uri, timeout=10).read()
+
+
+def _post(uri: str, body: bytes, ctype: str = "text/plain") -> bytes:
+    req = urllib.request.Request(
+        uri, data=body, headers={"Content-Type": ctype}, method="POST"
+    )
+    return urllib.request.urlopen(req, timeout=10).read()
+
+
+def main() -> int:
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(
+        port=0,
+        batch_window=0.002,
+        batch_max_size=32,
+        rescache_promote_hits=3,
+    )
+    node.start()
+    try:
+        base = node.uri
+        _post(f"{base}/index/rc", b"{}", "application/json")
+        for f in ("f", "g"):
+            _post(
+                f"{base}/index/rc/field/{f}",
+                b'{"options": {}}',
+                "application/json",
+            )
+        _post(
+            f"{base}/index/rc/query",
+            b"Set(1, f=1) Set(2, f=1) Set(3, f=2) Set(1, g=1) Set(4, g=1)",
+        )
+
+        def rc_vars() -> dict:
+            return json.loads(_get(f"{base}/debug/vars"))["rescache"]
+
+        def query(q: str, profile: bool = False) -> dict:
+            suffix = "?profile=true" if profile else ""
+            return json.loads(
+                _post(f"{base}/index/rc/query{suffix}", q.encode())
+            )
+
+        # 1. repeat query -> hit, identical payload
+        q_f = "Count(Row(f=1))"
+        q_g = "Count(Row(g=1))"
+        first = query(q_f)
+        assert first["results"] == [2], first
+        before = rc_vars()
+        second = query(q_f)
+        assert second == first, (first, second)
+        after = rc_vars()
+        assert after["hits"] == before["hits"] + 1, (before, after)
+
+        # 2. targeted write -> precise invalidation: g's entry drops,
+        # f's entry keeps serving
+        query(q_g)  # seed g's entry
+        before = rc_vars()
+        _post(f"{base}/index/rc/query", b"Set(9, g=1)")
+        assert query(q_g)["results"] == [3]  # fresh, not stale
+        hit_floor = rc_vars()["hits"]
+        assert query(q_f)["results"] == [2]  # f survived the g write
+        after = rc_vars()
+        assert after["invalidations"] > before["invalidations"], (before, after)
+        assert after["hits"] > hit_floor - 1 and after["hits"] >= before["hits"] + 1, (
+            before,
+            after,
+        )
+
+        # 3. hot TopN promotes; a write refreshes it in place and the
+        # readback carries the post-write counts
+        for _ in range(5):
+            query("TopN(f)")
+        assert rc_vars()["promotions"] >= 1, rc_vars()
+        _post(f"{base}/index/rc/query", b"Set(5, f=2) Set(6, f=2) Set(7, f=2)")
+        top = query("TopN(f)")["results"][0]
+        got = [(p["id"], p["count"]) for p in top]
+        assert got == [(2, 4), (1, 2)], got
+        snap = rc_vars()
+        assert snap["maintainedHits"] >= 1 and snap["maintainedEntries"] >= 1, snap
+
+        # 4. operator surfaces
+        metrics = _get(f"{base}/metrics").decode()
+        for series in (
+            "pilosa_rescache_hits",
+            "pilosa_rescache_misses",
+            "pilosa_rescache_invalidations",
+            "pilosa_rescache_promotions",
+        ):
+            assert series in metrics, f"{series} missing from /metrics"
+
+        prof = query("Count(Row(g=1))", profile=True)
+        names = json.dumps(prof.get("profile", {}))
+        assert "rescache.lookup" in names, names[:600]
+
+        frags = json.loads(_get(f"{base}/debug/fragments"))
+        assert frags["fragments"], frags
+        for row in frags["fragments"]:
+            assert "version" in row and "epoch" in row, row
+        assert frags["totals"]["version"] >= 1, frags["totals"]
+
+        print(
+            "smoke_rescache OK: "
+            f"hits={snap['hits']} misses={snap['misses']} "
+            f"invalidations={snap['invalidations']} "
+            f"promotions={snap['promotions']} "
+            f"maintainedHits={snap['maintainedHits']}"
+        )
+        return 0
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,10 @@ def main() -> int:
         device_budget=BUDGET_STACKS * stack_bytes + 256,
         batch_window=0.003,
         batch_max_size=32,
+        # rescache off: this smoke asserts device hit/miss and prefetch
+        # usefulness on repeat queries, which the semantic result cache
+        # would serve before they reach the residency tier
+        rescache_entries=0,
     )
     node.start()
     try:
