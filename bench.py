@@ -161,6 +161,47 @@ def _sync(x) -> np.ndarray:
     return np.asarray(jax.tree.leaves(x)[0])
 
 
+def _devcost_mark() -> dict:
+    """Flat device-ledger counters at a lane boundary (obs/devledger.py)."""
+    from pilosa_tpu.obs import devledger
+
+    return dict(devledger.counters())
+
+
+def _devcost_delta(mark: dict, lane: str, forbid_compiles: bool = False) -> dict:
+    """Ledger delta since ``mark`` for a lane's BENCH JSON block.
+
+    With ``forbid_compiles`` the lane asserts its warm steady state: ANY
+    post-warmup XLA compile fails the lane loudly, naming the sites that
+    compiled — a silent recompile-per-request bug would otherwise flatter
+    itself as throughput spread."""
+    from pilosa_tpu.obs import devledger
+
+    cur = devledger.counters()
+    compiles = cur["compiles"] - mark.get("compiles", 0)
+    out = {
+        "compiles": compiles,
+        "launches": cur["launches"] - mark.get("launches", 0),
+        "transfer_bytes": (
+            cur["h2dBytes"] + cur["d2hBytes"]
+            - mark.get("h2dBytes", 0) - mark.get("d2hBytes", 0)
+        ),
+    }
+    if forbid_compiles and compiles > 0:
+        suffix = ".compiles"
+        sites = sorted(
+            (k[len("site."):-len(suffix)], cur[k] - mark.get(k, 0))
+            for k in cur
+            if k.startswith("site.") and k.endswith(suffix)
+            and cur[k] - mark.get(k, 0) > 0
+        )
+        raise RuntimeError(
+            f"{lane} lane: {compiles} XLA compile(s) after warmup "
+            f"(per site: {sites or 'unattributed'})"
+        )
+    return out
+
+
 def _bsi_range_fn(depth, value):
     """Jitted all-shards BSI `field < value` count using the framework's
     plane-scan kernel (pilosa_tpu/ops/bsi.py) vmapped over shards."""
@@ -271,6 +312,9 @@ def _served_concurrency_sweep() -> dict:
         # steady state, not the one-time gram build
         for _ in range(40):
             api.query("swp", q.decode())
+        # warm steady state is ASSERTED below: zero XLA compiles across
+        # the whole sweep after this mark
+        devmark = _devcost_mark()
         host, port = srv.host, srv.server.port
 
         def run_level(clients: int, per_client: int) -> dict:
@@ -352,6 +396,9 @@ def _served_concurrency_sweep() -> dict:
             "coalesced": snap1["coalesced"] - snap0["coalesced"],
             "window_closes": closes,
             "batch_size_hist": hist,
+            "devledger": _devcost_delta(
+                devmark, "served_sweep", forbid_compiles=True
+            ),
         }
     finally:
         srv.stop()
@@ -515,6 +562,14 @@ def _mesh_dist_lane() -> dict:
                 raise RuntimeError(
                     f"mesh lane parity broke for {q}: {got} != {want}"
                 )
+        # push both sides past the executor's single-query warm gates so
+        # every timed rep rides its steady-state lane, then assert zero
+        # XLA compiles across the timed blocks
+        for q in queries.values():
+            for _ in range(8):
+                api_s.query("md", q)
+                api_m.query("md", q)
+        devmark = _devcost_mark()
         reps = {"count": 60, "topn": 30, "range": 30}
         best = {k: {"mesh": 0.0, "solo": 0.0} for k in queries}
         for _ in range(3):
@@ -527,6 +582,7 @@ def _mesh_dist_lane() -> dict:
                     qps = n_reps / (time.perf_counter() - t0)
                     best[key][side] = max(best[key][side], qps)
         snap = api_m.dist.snapshot()
+        devcosts = _devcost_delta(devmark, "mesh_dist", forbid_compiles=True)
     if http_calls:
         raise RuntimeError(
             f"mesh lane issued {len(http_calls)} HTTP subrequests"
@@ -550,6 +606,7 @@ def _mesh_dist_lane() -> dict:
         "nodes": 8,
         "mesh_dispatches": snap["meshDispatches"],
         "mesh_fallbacks": snap["meshFallbacks"],
+        "devledger": devcosts,
     }
 
 
@@ -749,9 +806,13 @@ def _rescache_lane(serving_floor_ms: float) -> dict:
         try:
             seed(api)
             # warm both sides identically: fills the cache on the
-            # cached side, warms the per-snapshot serving caches on both
+            # cached side, and on the uncached side pushes every pool
+            # template past the executor's single-query warm gates so
+            # the hit block rides the device steady state
             for q in pool:
-                api.query("rc", q)
+                for _ in range(8):
+                    api.query("rc", q)
+            devmark = _devcost_mark()
             # hit block: pure zipfian repeats over the warm pool — on
             # the cached side every read is cache-served, so this pair
             # of walls IS the hit-qps vs uncached-qps ratio
@@ -762,6 +823,12 @@ def _rescache_lane(serving_floor_ms: float) -> dict:
                 api.query("rc", q)
                 lats.append(time.perf_counter() - tq)
             hit_wall = time.perf_counter() - t0
+            # the headline block must be recompile-free on BOTH sides:
+            # cache-served reads launch nothing, uncached reads replay
+            # programs compiled during warmup
+            hit_devcosts = _devcost_delta(
+                devmark, f"rescache(entries={entries})", forbid_compiles=True
+            )
             # mixed block: the same reads with interleaved writes — the
             # invalidation-under-traffic realism the hit block omits
             snap0 = api.executor.rescache.snapshot()
@@ -785,6 +852,7 @@ def _rescache_lane(serving_floor_ms: float) -> dict:
                 "hit_qps": n_hit / hit_wall,
                 "hit_p50_ms": lats[len(lats) // 2] * 1e3,
                 "mixed_qps": n_ops / mixed_wall,
+                "devledger": hit_devcosts,
                 "delta": {
                     k: snap1[k] - snap0[k]
                     for k in (
@@ -816,6 +884,11 @@ def _rescache_lane(serving_floor_ms: float) -> dict:
         # accounting while writes invalidate / refresh underneath
         "mixed_qps_cached": round(cached["mixed_qps"], 1),
         "mixed_qps_uncached": round(uncached["mixed_qps"], 1),
+        # hit-block ledger deltas: the cached side serves from the
+        # result cache (zero device launches is the design), the
+        # uncached side replays warm programs (launches, no compiles)
+        "devledger_cached": cached["devledger"],
+        "devledger_uncached": uncached["devledger"],
         "hit_rate": round(d["hits"] / reads, 3) if reads else None,
         **{f"cache_{k}": v for k, v in d.items()},
         "pass_hit_p50": cached["hit_p50_ms"] < serving_floor_ms,
@@ -1333,6 +1406,10 @@ def main() -> None:
     # path is bandwidth-heavy)
     sustained_nodev_bits_s = 0.0
     sustained_bits_s = 0.0
+    # ledger deltas across the whole sustained lane: the open
+    # BENCH_TPU_MANUAL.md in-bench sensitivity item needs to know
+    # whether the slow in-bench runs hide recompiles or extra transfers
+    sustained_devmark = _devcost_mark()
     for _ in range(2):
         with tempfile.TemporaryDirectory() as d:
             sq = SnapshotQueue(workers=2)
@@ -1361,6 +1438,7 @@ def main() -> None:
                 sustained_bits_s = withdev
             sq.stop()
             store.close()
+    sustained_devcosts = _devcost_delta(sustained_devmark, "sustained_ingest")
 
     # -- pipelined ingest: the staged pipeline (native zero-copy decode
     # -> coalesced apply on the worker pool -> double-buffered device
@@ -1674,6 +1752,10 @@ def main() -> None:
         "sustained_ingest_vs_baseline": round(
             sustained_bits_s / cpu_ingest_bits_s, 1
         ),
+        # compile/transfer accounting for the sustained lane (the
+        # BENCH_TPU_MANUAL.md in-bench sensitivity item: recompiles or
+        # transfer inflation would now show here)
+        "sustained_ingest_devledger": sustained_devcosts,
         # staged-pipeline lane (pilosa_tpu/ingest/): same roaring
         # segments through the pipeline vs the lock-step path;
         # overlap_frac = fraction of H2D bytes whose upload ran while an

@@ -39,9 +39,14 @@ from pilosa_tpu.cluster.topology import NODE_STATE_DOWN
 from pilosa_tpu.cluster.wire import decode_results
 from pilosa_tpu.exec.executor import ExecuteError, Executor, IndexNotFoundError
 from pilosa_tpu.exec.result import GroupCount, Pair, Row, RowIdentifiers, ValCount
-from pilosa_tpu.obs import qprofile, tracing
+from pilosa_tpu.obs import devledger, qprofile, tracing
 from pilosa_tpu.parallel import meshplace
 from pilosa_tpu.pql.ast import Call
+
+# Device cost ledger site for mesh-local collective dispatches.  The
+# window wraps the whole facade launch; inner kernel funnels claim their
+# own compiles out of it, so this site keeps only mesh-plan-level costs.
+_DL_MESH = devledger.site("cluster.mesh_dispatch")
 
 logger = logging.getLogger(__name__)
 
@@ -603,6 +608,8 @@ class DistributedExecutor:
         span.set_tag("shards", len(shards))
         with span, qprofile.span(
             "meshDispatch", nodes=len(owners), shards=len(shards)
+        ), _DL_MESH.launch(
+            sig=f"{call.name} nodes{len(owners)} shards{len(shards)}"
         ):
             # through the facade executor's own semantic cache: the
             # partial is keyed by the owners' REAL fragment versions
@@ -755,7 +762,7 @@ class DistributedExecutor:
                     mspan.set_tag("queries", len(items))
                     with mspan, qprofile.span(
                         "meshDispatch", queries=len(items)
-                    ):
+                    ), _DL_MESH.launch(sig=f"batch q{len(items)}"):
                         got = ex.execute_batch(
                             index_name,
                             [(q, list(sh)) for _, q, sh in items],

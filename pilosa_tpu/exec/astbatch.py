@@ -41,7 +41,14 @@ from jax import lax
 from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.obs import devledger
 from pilosa_tpu.pql.ast import Call, Condition
+
+# Device cost ledger site for compiled-plan launches: every run_* call
+# opens a launch window so XLA compiles (new AST shape or batch bucket)
+# attribute here, and the compiled-callable identity feeds cache-hit
+# accounting.
+_DL = devledger.site("exec.astbatch")
 
 # Device launches issued by compiled programs (tests assert O(1) per
 # batch; one count-group launch answers every same-shape Count).
@@ -334,12 +341,24 @@ def run_count_batch(sig, stacks: tuple, slots_np: np.ndarray) -> np.ndarray:
         )
         assert slots_np.shape[1] == n_leaves
         launches += 1
-        hi, lo = fn(*stacks, jnp.asarray(slots_np))
+        label = f"count_span B{slots_np.shape[0]} S{stacks[0].shape[0]}"
+        _DL.track(fn, (slots_np.shape, stacks[0].shape))
+        with _DL.launch(sig=label):
+            hi, lo = fn(*stacks, jnp.asarray(slots_np))
         return _k._hi_lo_total(hi, lo)
     fn, n_leaves = compiled(sig, True)
     assert slots_np.shape[1] == n_leaves
     launches += 1
-    partials = np.asarray(fn(stacks, jnp.asarray(slots_np))).astype(np.int64)
+    label = f"count B{slots_np.shape[0]} S{stacks[0].shape[0]}"
+    _DL.track(fn, (slots_np.shape, tuple(s.shape for s in stacks)))
+    with _DL.launch(sig=label) as w:
+        partials = np.asarray(
+            fn(stacks, jnp.asarray(slots_np))
+        ).astype(np.int64)
+    if w.compiles:
+        devledger.ledger().analyze_cost(
+            _DL, fn, stacks, jnp.asarray(slots_np), sig=label
+        )
     return partials.sum(axis=1)
 
 
@@ -349,7 +368,9 @@ def run_bitmap(sig, stacks: tuple, slots_np: np.ndarray):
     fn, n_leaves = compiled(sig, False)
     assert slots_np.shape[0] == n_leaves
     launches += 1
-    return fn(stacks, jnp.asarray(slots_np))
+    _DL.track(fn, tuple(s.shape for s in stacks))
+    with _DL.launch(sig=f"bitmap S{stacks[0].shape[0]}"):
+        return fn(stacks, jnp.asarray(slots_np))
 
 
 # ------------------------------------------------------------- BSI signing

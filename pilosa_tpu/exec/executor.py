@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu import deadline, pql
 from pilosa_tpu.core import membudget, residency, timequantum
-from pilosa_tpu.obs import qprofile, tracing
+from pilosa_tpu.obs import devledger, qprofile, tracing
 from pilosa_tpu.core.field import (
     FIELD_TYPE_BOOL,
     FIELD_TYPE_INT,
@@ -57,6 +57,11 @@ DEFAULT_MIN_THRESHOLD = 1
 
 # Sentinel for "not yet computed" result slots in the batch fast path.
 _UNSET = object()
+
+# Device cost ledger site for executor-owned launches: stack uploads and
+# the BSI predicate/aggregate dispatches that don't funnel through the
+# kernels dispatch notes (those book under ops.kernels / ops.bsi).
+_DL_STACK = devledger.site("executor.stack_launch")
 
 # Largest stacked [S, R, W] tensor the batch fast path will materialize.
 _STACK_BUDGET_BYTES = 4 << 30  # device serving stacks; tuned for v5e HBM
@@ -608,7 +613,7 @@ class Executor:
             self.stack_rebuilds += 1
             from pilosa_tpu.ops import kernels
 
-            kernels.note_transfer(nbytes, "h2d")
+            kernels.note_transfer(nbytes, "h2d", dl_site=_DL_STACK)
             qprofile.incr("stack_rebuilds")
             # a BSI depth autogrow (or a standard view's row-set change)
             # retires same-(mesh, shards, view) entries with a different
@@ -2140,7 +2145,9 @@ class Executor:
                 f.fill_bsi_tensors_host(
                     depth, planes[si], exists[si], sign[si]
                 )
-            with jax.default_device(cpu):
+            with _DL_STACK.launch(
+                sig=f"bsi_rows/host d{depth}"
+            ), jax.default_device(cpu):
                 mask = np.asarray(
                     kernel(
                         jnp.asarray(planes), jnp.asarray(exists),
@@ -2154,7 +2161,8 @@ class Executor:
         if st is not None:
             exists, sign, planes = self._bsi_split(st)
             self.bsi_stack_launches += 1
-            mask = kernel(planes, exists, sign)  # [S, W], one launch
+            with _DL_STACK.launch(sig=f"bsi_rows/stack d{field.bit_depth}"):
+                mask = kernel(planes, exists, sign)  # [S, W], one launch
             if getattr(mask, "sharding", None) is not None and len(
                 getattr(mask.sharding, "device_set", ())
             ) > 1:
@@ -2170,7 +2178,8 @@ class Executor:
             if frag is None:
                 continue
             planes, exists, sign = frag.bsi_tensors(field.bit_depth)
-            out.segments[shard] = kernel(planes, exists, sign)
+            with _DL_STACK.launch(sig=f"bsi_rows/frag d{field.bit_depth}"):
+                out.segments[shard] = kernel(planes, exists, sign)
         return out
 
     # ------------------------------------------------------------ aggregates
@@ -2488,6 +2497,7 @@ class Executor:
                 fw = jax.device_put(fw_np, sh)  # co-locate with stack
             else:
                 fw = jnp.asarray(fw_np)
+            _DL_STACK.record_transfer(fw_np.nbytes, "h2d")
         return planes, exists, sign, fw
 
     def _bsi_agg_serve(self, field: Field, stacked, key: str, compute):
@@ -2504,7 +2514,8 @@ class Executor:
         if cached is None:
             planes, exists, sign, fw = self._bsi_tensors(field, stacked)
             self.bsi_stack_launches += 1
-            cached = compute(planes, exists, sign, fw)
+            with _DL_STACK.launch(sig=f"bsi_agg/{key.split(':', 1)[0]}"):
+                cached = compute(planes, exists, sign, fw)
             put(cached)
         return cached
 

@@ -33,6 +33,13 @@ import threading
 import time
 
 from pilosa_tpu.ingest.staging import DEFAULT_CAPACITY, StagingPool
+from pilosa_tpu.obs import devledger
+
+# Device cost ledger sites: upload windows adopt the fragment sync's
+# compiles and H2D bytes (kernels.note_transfer books to the active
+# window's site), splitting ingest uploads from predictive prefetches.
+_DL_UPLOAD = devledger.site("ingest.upload")
+_DL_PREFETCH = devledger.site("server.prefetch")
 
 _STOP = object()
 
@@ -190,7 +197,8 @@ class DeviceUploader:
         tracker = residency.default_tracker()
         tracker.enter_prefetch()
         try:
-            frag.device_bits()
+            with _DL_PREFETCH.launch(sig="prefetch_sync"):
+                frag.device_bits()
         except Exception as e:  # advisory: the query path syncs lazily
             err = e
         finally:
@@ -247,7 +255,8 @@ class DeviceUploader:
             t0 = time.perf_counter()
             nbytes = 0
             try:
-                frag.device_bits()
+                with _DL_UPLOAD.launch(sig="ingest_sync"):
+                    frag.device_bits()
                 nbytes = int(getattr(frag, "last_sync_h2d_bytes", 0))
             except Exception:
                 # Upload is an accelerator warm-path optimization; the

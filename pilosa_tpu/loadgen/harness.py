@@ -59,7 +59,13 @@ class StageSpec:
     reads recur zipfian over that many fixed query templates while
     writes keep randomizing — the dashboard-refresh shape that
     exercises the semantic result cache, whose per-stage hit/
-    invalidation deltas land in the report entry (docs/caching.md)."""
+    invalidation deltas land in the report entry (docs/caching.md).
+
+    ``tenant`` stamps every request of the stage with an
+    ``X-Pilosa-Tenant`` header, so the stage's device work lands under
+    that principal in the device cost ledger (docs/observability.md);
+    the per-stage ``devcosts`` delta and the report's top-level
+    ``devcosts`` block show the attribution."""
 
     def __init__(
         self,
@@ -70,6 +76,7 @@ class StageSpec:
         mix: dict[str, float] | None = None,
         device_budget: int | None = None,
         repeat_pool: int | None = None,
+        tenant: str | None = None,
     ):
         self.name = name
         self.duration = float(duration)
@@ -80,6 +87,7 @@ class StageSpec:
             int(device_budget) if device_budget is not None else None
         )
         self.repeat_pool = int(repeat_pool) if repeat_pool else None
+        self.tenant = str(tenant) if tenant else None
 
     @property
     def op_count(self) -> int:
@@ -94,6 +102,7 @@ class StageSpec:
             "mix": self.mix,
             "deviceBudget": self.device_budget,
             "repeatPool": self.repeat_pool,
+            "tenant": self.tenant,
         }
 
 
@@ -154,9 +163,13 @@ def _worker(
     q: "queue.Queue",
     out: _WorkerResult,
     stop: threading.Event,
+    tenant: str | None = None,
 ) -> None:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
+    headers = {"Content-Type": ""}
+    if tenant:
+        headers["X-Pilosa-Tenant"] = tenant
     try:
         while not stop.is_set():
             item = q.get()
@@ -169,11 +182,12 @@ def _worker(
             t_start = time.monotonic()
             status = 0
             try:
+                headers["Content-Type"] = op.ctype
                 conn.request(
                     op.method,
                     op.path,
                     body=op.body,
-                    headers={"Content-Type": op.ctype},
+                    headers=headers,
                 )
                 resp = conn.getresponse()
                 resp.read()
@@ -269,6 +283,30 @@ def _rescache_delta(before: dict | None, after: dict | None) -> dict | None:
     return delta
 
 
+def _devcost_counters(base: str) -> dict | None:
+    """Monotonic device-cost-ledger totals from /debug/devcosts,
+    flattened for per-stage delta arithmetic (None when the node
+    predates the device cost ledger)."""
+    dc = _fetch_json(base, "/debug/devcosts")
+    if not dc or "totals" not in dc:
+        return None
+    tot = dc.get("totals") or {}
+    return {
+        "compiles": tot.get("compiles", 0),
+        "compileMs": tot.get("compileMs", 0.0),
+        "launches": tot.get("launches", 0),
+        "deviceMs": tot.get("deviceMs", 0.0),
+        "transferBytes": tot.get("h2dBytes", 0) + tot.get("d2hBytes", 0),
+        "storms": len((dc.get("storm") or {}).get("recent", [])),
+    }
+
+
+def _devcost_delta(before: dict | None, after: dict | None) -> dict | None:
+    if before is None or after is None:
+        return None
+    return {k: round(after[k] - before[k], 3) for k in before}
+
+
 def _fetch_text(base: str, path: str) -> str:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
@@ -349,6 +387,7 @@ class LoadHarness:
             # accounted and the shrink evicts the live working set.
             res_before = _residency_counters(self.uris[0])
             rc_before = _rescache_counters(self.uris[0])
+            dc_before = _devcost_counters(self.uris[0])
             prev_cap: tuple | None = None
             if stage.device_budget is not None:
                 from pilosa_tpu.core import membudget
@@ -363,7 +402,10 @@ class LoadHarness:
             threads = [
                 threading.Thread(
                     target=_worker,
-                    args=(self.uris[w % len(self.uris)], q, outs[w], stop),
+                    args=(
+                        self.uris[w % len(self.uris)], q, outs[w], stop,
+                        stage.tenant,
+                    ),
                     name=f"loadgen-{stage.name}-{w}",
                     daemon=True,
                 )
@@ -430,6 +472,9 @@ class LoadHarness:
                     "rescache": _rescache_delta(
                         rc_before, _rescache_counters(self.uris[0])
                     ),
+                    "devcosts": _devcost_delta(
+                        dc_before, _devcost_counters(self.uris[0])
+                    ),
                 }
             )
         wall = time.monotonic() - t_run0
@@ -449,6 +494,9 @@ class LoadHarness:
         rescache = None
         if final_vars and "rescache" in final_vars:
             rescache = final_vars.get("rescache")
+        # end-of-run ledger state: per-site and per-principal accounting
+        # (the tenant-labeled stages show up as principals here)
+        devcosts = _fetch_json(self.uris[0], "/debug/devcosts")
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -463,6 +511,7 @@ class LoadHarness:
             events=events,
             residency=residency,
             rescache=rescache,
+            devcosts=devcosts,
         )
 
 

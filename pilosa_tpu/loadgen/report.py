@@ -37,6 +37,7 @@ def build_report(
     events: dict | None = None,
     residency: dict | None = None,
     rescache: dict | None = None,
+    devcosts: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -109,6 +110,12 @@ def build_report(
         # repeat-heavy stage in the plan, the per-stage entries carry
         # the hit/invalidation deltas observed while it ran
         "rescache": rescache,
+        # end-of-run device cost ledger (docs/observability.md): per-site
+        # compile/launch/transfer accounting plus per-principal rows —
+        # tenant-labeled stages (StageSpec.tenant) land here under their
+        # (tenant, index, opClass) principals; per-stage entries carry
+        # the compile/launch/transfer deltas observed while each ran
+        "devcosts": devcosts,
         "verdicts": verdicts,
         "pass": overall,
     }

@@ -70,6 +70,7 @@ class FlightRecorder:
         self._last_504 = None  # counter baseline; None until first segment
         self._spike_armed = True
         self._last_dispatch = None
+        self._last_devcosts = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -144,6 +145,22 @@ class FlightRecorder:
             seg["kernelDispatchDelta"] = total - self._last_dispatch
             self._last_dispatch = total
         except Exception:  # graftlint: disable=exception-hygiene -- kernel telemetry is optional on CPU-only builds
+            pass
+        try:
+            from pilosa_tpu.obs import devledger
+
+            dev = devledger.counters()
+            cur = {
+                "compiles": dev["compiles"],
+                "launches": dev["launches"],
+                "transferBytes": dev["h2dBytes"] + dev["d2hBytes"],
+            }
+            last = self._last_devcosts or cur
+            seg["devledgerDelta"] = {
+                k: cur[k] - last[k] for k in cur
+            }
+            self._last_devcosts = cur
+        except Exception:  # graftlint: disable=exception-hygiene -- ledger deltas are advisory segment context
             pass
         client = self.client
         if client is not None and hasattr(client, "breaker_states"):
@@ -244,6 +261,20 @@ class FlightRecorder:
                 **{k: v for k, v in trigger.items() if k != "type"},
             )
         except Exception:  # graftlint: disable=exception-hygiene -- journaling is best-effort
+            pass
+
+    def capture_incident(self, trigger: dict) -> None:
+        """External incident trigger (the device ledger's recompile-storm
+        callback): freeze a bundle around the current segments.  Safe to
+        call from any thread; failures must not reach the caller.  A
+        stopped recorder ignores triggers — the process-global ledger
+        outlives individual nodes in multi-node test processes."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            return
+        try:
+            self._capture(dict(trigger))
+        except Exception:  # graftlint: disable=exception-hygiene -- external triggers are best-effort
             pass
 
     # -- exposition ----------------------------------------------------------

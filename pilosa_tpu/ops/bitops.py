@@ -121,7 +121,7 @@ def popcount(words: jax.Array) -> jax.Array:
     return lax.population_count(words)
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def count_bits(words: jax.Array) -> jax.Array:
     """Total set bits in a word tensor -> int32 scalar.
 
@@ -131,7 +131,7 @@ def count_bits(words: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(words).astype(jnp.int32))
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def count_rows(bits: jax.Array) -> jax.Array:
     """Row-wise popcount: ``uint32[..., rows, W] -> int32[..., rows]``.
 
@@ -141,7 +141,7 @@ def count_rows(bits: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(bits).astype(jnp.int32), axis=-1)
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def intersection_count(a: jax.Array, b: jax.Array) -> jax.Array:
     """popcount(a & b) without materializing the AND (XLA fuses the chain).
 
@@ -151,17 +151,17 @@ def intersection_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(a & b).astype(jnp.int32))
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def union_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(a | b).astype(jnp.int32))
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def difference_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(a & ~b).astype(jnp.int32))
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def xor_count(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.sum(lax.population_count(a ^ b).astype(jnp.int32))
 
@@ -170,7 +170,7 @@ def zero_row(n_words: int = SHARD_WORDS) -> jax.Array:
     return jnp.zeros((n_words,), dtype=jnp.uint32)
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=launch-discipline -- word-level helpers; callers dispatch them beneath ops.kernels/executor ledger windows
 def shift_row(words: jax.Array, n: jax.Array | int = 1) -> jax.Array:
     """Shift all bits toward higher column ids by ``n`` (reference
     roaring.go:944 ``Shift``; only n=1 is used by PQL's Shift call, but the

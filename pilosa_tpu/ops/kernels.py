@@ -55,9 +55,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.compat import shard_map
 
-from pilosa_tpu.obs import qprofile
+from pilosa_tpu.obs import devledger, qprofile
 from pilosa_tpu.obs.stats import MemStatsClient
 from pilosa_tpu.ops.bitops import pow2_pad_len
+
+# Device cost ledger sites: every batched-kernel dispatch funnels through
+# _note_dispatch, which claims the thread's XLA compile events and books
+# the launch — BSI batched lanes report under their own site so the ledger
+# splits standard-row vs BSI kernel costs.
+_DL_KERNELS = devledger.site("ops.kernels")
+_DL_BSI = devledger.site("ops.bsi")
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +199,7 @@ def _note_dispatch(
     useful_bytes: int = 0,
     extra: dict | None = None,
     extra_tags: tuple = (),
+    dl_site=None,
 ) -> None:
     """Record one kernel dispatch: tagged counters/timings into
     ``kernel_stats`` plus a per-kernel record into the active query
@@ -207,6 +215,14 @@ def _note_dispatch(
         miss = key not in _seen_programs
         if miss and len(_seen_programs) < _MAX_SEEN_PROGRAMS:
             _seen_programs.add(key)
+    if lane != "host":
+        # Ledger booking: the jit call already returned on this thread, so
+        # any XLA compiles it triggered sit in the thread stash — claim
+        # them under this site, and book the launch + identity.
+        site = dl_site or _DL_KERNELS
+        site.track_key(key)
+        site.claim(sig=f"{kernel}/{lane}:{key[2]}")
+        site.record_launch(wall or 0.0)
     tagged = kernel_stats.with_tags(
         f"kernel:{kernel}", f"lane:{lane}", *extra_tags
     )
@@ -261,6 +277,7 @@ def note_bsi_dispatch(
         extra={"depth": int(depth), "qBucket": int(q_bucket),
                "qUseful": int(q_useful)},
         extra_tags=(f"depth:{depth}", f"qbucket:{q_bucket}"),
+        dl_site=_DL_BSI,
     )
     if q_bucket > q_useful:
         # pow2 Q padding: queries, scaled to the per-query input bytes
@@ -273,13 +290,17 @@ def note_bsi_dispatch(
         )
 
 
-def note_transfer(nbytes: int, direction: str) -> None:
-    """Count host<->device traffic (``direction``: "h2d" | "d2h")."""
+def note_transfer(nbytes: int, direction: str, dl_site=None) -> None:
+    """Count host<->device traffic (``direction``: "h2d" | "d2h").
+    ``dl_site`` routes the ledger booking to the caller's registered site
+    (executor stack builds, fragment syncs); defaults to ops.kernels."""
     if nbytes:
         kernel_stats.with_tags(f"direction:{direction}").count(
             "kernel_transfer_bytes", int(nbytes)
         )
         qprofile.incr(f"transfer_{direction}_bytes", int(nbytes))
+        site = dl_site or devledger.active_window_site() or _DL_KERNELS
+        site.record_transfer(int(nbytes), direction)
 
 
 def note_pad(kernel: str, padded_bytes: int, useful_bytes: int) -> None:

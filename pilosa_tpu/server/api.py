@@ -19,6 +19,7 @@ import numpy as np
 
 from pilosa_tpu import __version__, deadline
 from pilosa_tpu.cluster.client import ClientError
+from pilosa_tpu.obs import devledger
 from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import qprofile, slo
 from pilosa_tpu.testing import faults
@@ -313,19 +314,24 @@ class API:
         q = pql.parse(pql_text) if isinstance(pql_text, str) else pql_text
         # SLO op class rides a contextvar to the HTTP layer's recording
         # point (this thread handles the whole request).
-        slo.note_class(slo.classify_query(q))
-        batcher = self.batcher
-        dist = self.dist
-        if batcher is not None and batcher.accepts(q):
-            if (
-                dist is None
-                or dist._single
-                or dist.mesh_complete(index, q, shards)
-            ):
-                return batcher.submit(index, q, shards=shards)
-        if dist is not None:
-            return dist.execute(index, q, shards=shards)
-        return self.executor.execute(index, q, shards=shards)
+        op_class = slo.classify_query(q)
+        slo.note_class(op_class)
+        # Device cost ledger principal: every launch this query causes —
+        # inline, batched (the flight snapshots it at submit), or
+        # mesh-dispatched — books under (tenant, index, op_class).
+        with devledger.principal_scope(index, op_class):
+            batcher = self.batcher
+            dist = self.dist
+            if batcher is not None and batcher.accepts(q):
+                if (
+                    dist is None
+                    or dist._single
+                    or dist.mesh_complete(index, q, shards)
+                ):
+                    return batcher.submit(index, q, shards=shards)
+            if dist is not None:
+                return dist.execute(index, q, shards=shards)
+            return self.executor.execute(index, q, shards=shards)
 
     # -- schema CRUD (reference api.go:161-495) -----------------------------
 

@@ -64,7 +64,7 @@ import time
 
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
-from pilosa_tpu.obs import qprofile
+from pilosa_tpu.obs import devledger, qprofile
 
 logger = logging.getLogger(__name__)
 
@@ -76,8 +76,8 @@ class _Flight:
 
     __slots__ = (
         "index", "query", "shards", "event", "result", "error", "enqueued",
-        "deadline_at", "profiling", "batch_size", "reason", "queue_wait",
-        "dispatch_ms", "batch_profile",
+        "deadline_at", "profiling", "principal", "batch_size", "reason",
+        "queue_wait", "dispatch_ms", "batch_profile",
     )
 
     def __init__(self, index: str, query, shards):
@@ -92,6 +92,10 @@ class _Flight:
         # thread has neither the deadline nor the profile contextvar.
         self.deadline_at = deadline.at()
         self.profiling = qprofile.profiling()
+        # (tenant, index, op_class) for the device cost ledger: the
+        # dispatcher attributes the shared batched launch fractionally
+        # across every principal whose queries rode the flight.
+        self.principal = devledger.current_principal()
         self.batch_size = 0
         self.reason = ""
         self.queue_wait = 0.0
@@ -339,8 +343,17 @@ class QueryBatcher:
                 prof = qprofile.QueryProfile(
                     index, f"<batch of {len(items)}>"
                 )
+            # Weighted ledger attribution: one batched launch, split
+            # across the distinct principals riding this flight in
+            # proportion to their query count.
+            counts: dict[tuple, int] = {}
+            for item in items:
+                counts[item.principal] = counts.get(item.principal, 0) + 1
+            weights = [
+                (p, n / len(items)) for p, n in counts.items()
+            ]
             t0 = time.perf_counter()
-            with qprofile.activate(prof):
+            with qprofile.activate(prof), devledger.weighted_scope(weights):
                 outs = self.executor.execute_batch(
                     index, [(item.query, item.shards) for item in items]
                 )

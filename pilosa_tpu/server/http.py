@@ -35,7 +35,7 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
-from pilosa_tpu.obs import slo, tracestore, tracing
+from pilosa_tpu.obs import devledger, slo, tracestore, tracing
 from pilosa_tpu.server.api import API, ApiError
 
 logger = logging.getLogger(__name__)
@@ -68,6 +68,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/events$"), "debug_events"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/incidents$"), "debug_incidents"),
+    ("GET", re.compile(r"^/debug/devcosts$"), "debug_devcosts"),
     ("GET", re.compile(r"^/debug/jobs$"), "debug_jobs"),
     ("GET", re.compile(r"^/debug/fragments$"), "debug_fragments"),
     ("GET", re.compile(r"^/internal/diagnostics$"), "diagnostics"),  # graftlint: disable=dispatch-parity -- operator debug endpoint (curl/monitoring), never called node-to-node
@@ -203,7 +204,13 @@ class Handler(BaseHTTPRequestHandler):
                 # sampling decision at root completion reads both.
                 span.__enter__()
                 try:
-                    with deadline.scope(self._request_budget()):
+                    # Tenant attribution: the device cost ledger books
+                    # every launch this request causes under the header's
+                    # tenant (default "-"); the contextvar rides into the
+                    # api/executor layers and batcher flight snapshots.
+                    with devledger.tenant_scope(
+                        self.headers.get(devledger.TENANT_HEADER)
+                    ), deadline.scope(self._request_budget()):
                         getattr(self, "r_" + name)(**match.groupdict())
                 except DeadlineExceeded as e:
                     # Distinct from ApiError (400-family): a spent budget
@@ -313,6 +320,7 @@ class Handler(BaseHTTPRequestHandler):
             + prometheus_text(kernels.kernel_stats, exemplar_filter=filt)
             + prometheus_text(translate.translate_stats)
             + self.api.holder.slo.prometheus_text(exemplar_filter=filt)
+            + devledger.prometheus_text()
         )
         self._send(
             200,
@@ -349,6 +357,7 @@ class Handler(BaseHTTPRequestHandler):
         # residency-tier counters: hit/miss rates, prefetch yield, pin
         # policy outcomes (core/residency.py)
         snap["residency"] = residency.default_tracker().snapshot()
+        snap["devledger"] = devledger.snapshot()
         snap["events"] = self.api.holder.events.snapshot_summary()
         snap["slo"] = self.api.holder.slo.summary()
         snap["translate"] = translate.telemetry_snapshot()
@@ -444,6 +453,12 @@ class Handler(BaseHTTPRequestHandler):
                 self._send_json(200, detail)
             return
         self._send_json(200, self.api.incidents_snapshot())
+
+    def r_debug_devcosts(self):
+        """Device cost ledger: per-site and per-(tenant, index, op_class)
+        compile/launch/transfer accounting with rates, plus recompile-
+        storm state (obs/devledger.py)."""
+        self._send_json(200, devledger.snapshot())
 
     def r_debug_jobs(self):
         """Background-job records: active + bounded history, with phase,

@@ -191,6 +191,9 @@ class NodeServer:
         resize_watchdog_deadline: float = 15.0,
         mesh_dispatch: bool = True,
         device_budget: int | None = None,
+        devledger_storm_threshold: int = 8,
+        devledger_storm_window: float = 60.0,
+        devledger_warmup: float = 120.0,
     ):
         self.host = host
         # HBM budget override: device memory is process-global (one
@@ -354,6 +357,20 @@ class NodeServer:
                 spike_504=flightrec_spike_504,
             )
             self.api.flightrec = self.flightrec
+        # Device cost ledger: recompile-storm detection (>= threshold new
+        # XLA compiles inside the window, once past warmup) freezes a
+        # flight-recorder incident bundle naming the storming sites and
+        # shapes.  The ledger is process-global; the last-configured node
+        # wins in multi-node test processes (same rule as device_budget).
+        from pilosa_tpu.obs import devledger
+
+        devledger.configure_storm(
+            threshold=devledger_storm_threshold,
+            window_s=devledger_storm_window,
+            warmup_s=devledger_warmup,
+        )
+        if self.flightrec is not None:
+            devledger.on_storm(self.flightrec.capture_incident)
         self.gc_notifier = GCNotifier()
         self.runtime_monitor = RuntimeMonitor(
             self.holder.stats,

@@ -27,6 +27,7 @@ PER_FILE = [
     "queue_discipline",
     "residency_discipline",
     "cache_discipline",
+    "launch_discipline",
 ]
 
 
@@ -134,6 +135,23 @@ class TestBadCorpusCoverage:
         assert len(findings) == 5
         assert "private ResultCache state" in msgs
         assert "hand-written ResultCache counter" in msgs
+
+    def test_launch_classes(self):
+        findings = _check_corpus_file("launch_discipline", "bad")
+        msgs = " | ".join(f.message for f in findings)
+        # decorator, partial-decorator, call, shard_map, pmap all fire
+        assert len(findings) == 5
+        assert "direct jax.jit" in msgs
+        assert "direct shard_map" in msgs
+        assert "direct pmap" in msgs
+        assert all("device-cost-ledger" in f.message for f in findings)
+
+    def test_launch_ledger_and_shim_exempt(self):
+        p = BY_ID["launch-discipline"]
+        assert not p.applies("pilosa_tpu/obs/devledger.py")
+        assert not p.applies("pilosa_tpu/compat.py")
+        assert p.applies("pilosa_tpu/ops/kernels.py")
+        assert not p.applies("tools/bench.py")
 
     def test_cache_owner_itself_exempt(self):
         p = BY_ID["cache-discipline"]

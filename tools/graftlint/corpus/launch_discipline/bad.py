@@ -1,0 +1,31 @@
+"""launch-discipline bad corpus: device launches invisible to the ledger."""
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+@jax.jit
+def _count(words, mask):  # decorator form
+    return (words & mask).sum()
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _weighted(planes, depth):  # partial-decorator form
+    return planes * depth
+
+
+def build(fn):
+    return jax.jit(fn)  # call form
+
+
+def collective(local, mesh):
+    return shard_map(  # sharded collective launch
+        local, mesh=mesh, in_specs=P("x"), out_specs=P()
+    )
+
+
+def fan_out(fn):
+    return jax.pmap(fn)  # multi-device launch

@@ -1,0 +1,35 @@
+"""launch-discipline good corpus: jit usage in ledger-registered modules."""
+
+from functools import partial
+
+import jax
+
+from pilosa_tpu.obs import devledger
+
+_DL = devledger.site("corpus.good")
+
+
+@jax.jit
+def _masked_count(words, mask):
+    return (words & mask).sum()
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _weighted(planes, depth):
+    return planes * depth
+
+
+def dispatch(words, mask):
+    # the site window adopts any compile the call triggers
+    with _DL.launch(sig=f"count S{words.shape[0]}"):
+        return _masked_count(words, mask)
+
+
+def build(fn):
+    # registration via the module-level devledger reference above
+    return jax.jit(fn)
+
+
+def funnel_variant(nbytes, note_transfer):
+    # modules reporting through a kernels funnel are also registered
+    note_transfer(nbytes, "h2d")

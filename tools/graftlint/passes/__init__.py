@@ -7,6 +7,7 @@ from tools.graftlint.passes import (
     dtype_discipline,
     durability,
     exception_hygiene,
+    launch_discipline,
     lock_discipline,
     log_discipline,
     queue_discipline,
@@ -29,6 +30,7 @@ ALL_PASSES = [
     queue_discipline,
     residency_discipline,
     cache_discipline,
+    launch_discipline,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

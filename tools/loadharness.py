@@ -118,6 +118,9 @@ def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec
         StageSpec(
             "repeatread", sixth, rate, workers, REPEAT_READ_MIX,
             repeat_pool=REPEAT_POOL,
+            # tenant-labeled stage: its device work lands under the
+            # "dashboards" principal in the report's devcosts block
+            tenant="dashboards",
         ),
         StageSpec("ramp", sixth, rate * 1.5, workers, None),
     ]

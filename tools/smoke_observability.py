@@ -16,6 +16,12 @@ asserts the three operator-visible planes work over actual HTTP:
 * a concurrent int-field burst coalesces into query-batched BSI
   flights (batcher ``coalesced`` advances; the batched range-count
   kernel shows up in the dispatch telemetry);
+* the device cost ledger: ``/debug/devcosts`` carries per-site and
+  per-principal compile/launch/transfer accounting for the bursts
+  above, an ``X-Pilosa-Tenant``-labeled request lands under its own
+  principal, and a forced first-time XLA compile (an inline filtered
+  TopN — a kernel nothing earlier used) is visible as an
+  ``xlaCompiles`` tag on the kept trace's span detail;
 * the incident plane: an SLO-slow query and a deadline-504 query are
   tail-kept in ``/debug/traces`` (with span detail), ``/metrics``
   histograms cite a kept trace as an OpenMetrics exemplar, and a
@@ -56,8 +62,12 @@ def main() -> int:
         # incident-plane knobs: a 1 us read.count p99 objective makes
         # every count tail-kept as "slow"; fast burn windows + short
         # recorder segments keep the smoke quick
+        # the write objective's 5 ms latency bound doubles as the trace
+        # store's slow-keep threshold for write-class requests: the
+        # devledger stage's forced compile (~100 ms) must be tail-kept
         slo_objectives={
-            "read.count": {"availability": 0.999, "latencyP99Ms": 0.001}
+            "read.count": {"availability": 0.999, "latencyP99Ms": 0.001},
+            "write": {"availability": 0.999, "latencyP99Ms": 5.0},
         },
         slo_burn_rules=[
             {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4}
@@ -215,6 +225,55 @@ def main() -> int:
         assert vars_["batcher"]["coalesced"] > coalesced0, vars_["batcher"]
         metrics = _get(f"{base}/metrics").decode()
         assert "bsi_range_count_batch" in metrics, metrics[:400]
+
+        # -- device cost ledger: the bursts above drove real batched
+        # launches, so /debug/devcosts must already attribute them to
+        # their dispatch sites and to the default "-" tenant principal
+        dc = json.loads(_get(f"{base}/debug/devcosts"))
+        assert dc["totals"]["launches"] > 0, dc["totals"]
+        assert {"exec.astbatch", "ops.kernels", "executor.stack_launch"} <= set(
+            dc["sites"]
+        ), dc["sites"].keys()
+        assert any(s["launches"] > 0 for s in dc["sites"].values()), dc["sites"]
+        assert any(p["tenant"] == "-" and p["launches"] > 0
+                   for p in dc["principals"]), dc["principals"]
+        # a tenant-labeled request that forces a FIRST-TIME compile: the
+        # write call routes the whole request around the batcher onto
+        # the handler thread (where the request's trace span is live),
+        # and filtered TopN compiles the masked-count kernel nothing
+        # earlier used — one request proves tenant attribution AND the
+        # compile-on-span annotation at once
+        req = urllib.request.Request(
+            f"{base}/index/smoke/query",
+            data=b"Set(901, f=6) TopN(f, Row(f=1), n=3)",
+            headers={
+                "Content-Type": "text/plain",
+                "X-Pilosa-Tenant": "forensics",
+            },
+            method="POST",
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert out["results"][0] is True, out
+        dc = json.loads(_get(f"{base}/debug/devcosts"))
+        tenants = [p for p in dc["principals"] if p["tenant"] == "forensics"]
+        assert tenants and tenants[0]["index"] == "smoke", dc["principals"]
+        assert sum(p["compiles"] for p in tenants) >= 1, tenants
+        metrics = _get(f"{base}/metrics").decode()
+        assert "pilosa_dev_launches" in metrics, metrics[:400]
+        assert 'tenant="forensics"' in metrics, metrics[:400]
+        assert "devledger" in json.loads(_get(f"{base}/debug/vars")), "vars"
+        # the forced compile must be visible on the kept trace itself:
+        # scan recent kept traces for the span the ledger annotated
+        compiled_spans = []
+        for t in reversed(json.loads(_get(f"{base}/debug/traces"))["traces"]):
+            detail = json.loads(_get(f"{base}/debug/traces?id={t['traceId']}"))
+            compiled_spans = [
+                s["name"] for s in detail["spans"]
+                if (s.get("tags") or {}).get("xlaCompiles", 0) >= 1
+            ]
+            if compiled_spans:
+                break
+        assert "executor.executeTopN" in compiled_spans, compiled_spans
 
         # -- incident plane: tail-kept traces, exemplars, flight recorder
         # every Count above outran the 1 us objective: kept as "slow"
