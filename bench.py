@@ -896,6 +896,148 @@ def _rescache_lane(serving_floor_ms: float) -> dict:
     }
 
 
+def _planner_lane() -> dict:
+    """Flight-level query planner lane (docs/serving.md "Flight
+    planning"): the SAME zipfian repeat-heavy flight schedule through
+    the in-process batched API twice — planner on (the serving default)
+    vs ``planner_enabled=False`` — over identical data.  Every flight
+    is one multi-call query whose calls land in a single
+    ``execute_batch`` shard group, with >=50% of the calls embedding
+    one shared canonical subtree (drawn zipfian from a template pool,
+    one occurrence commutatively flipped to exercise canonicalization).
+    The shared subtrees carry BSI range conditions, which keeps them
+    off the compiled tree-count path — so the unplanned side pays the
+    host evaluation once PER CALL while the planned side pays it once
+    PER FLIGHT.  The result cache is pinned OFF on BOTH sides (and
+    asserted empty) so the speedup is attributable to cross-query CSE
+    alone, not caching.  Acceptance bars: planner-on/planner-off qps
+    >= 1.5x and zero post-warmup XLA compiles on either side."""
+    import random as _random
+
+    from pilosa_tpu.server.api import API
+
+    n_flights = 96
+    pool_theta = 1.2
+
+    def seed(api):
+        api.create_index("pl")
+        api.create_field("pl", "f")
+        api.create_field("pl", "g")
+        api.create_field("pl", "v", {"type": "int", "min": 0, "max": 1_000_000})
+        rng = np.random.default_rng(29)
+        width = api.holder.n_words * 32
+        writes = []
+        for row in range(8):
+            for c in rng.integers(0, width, size=100):
+                writes.append(f"Set({int(c)}, f={row})")
+        for row in range(4):
+            for c in rng.integers(0, width, size=60):
+                writes.append(f"Set({int(c)}, g={row})")
+        for c in sorted({int(c) for c in rng.integers(0, width, size=240)}):
+            writes.append(f"Set({c}, v={c % 999_983})")
+        api.query("pl", " ".join(writes))
+
+    # template pool: each entry is a (BSI lo, BSI hi, set row) triple
+    # defining one shared subtree; flights draw zipfian so the head
+    # templates dominate, the dashboard-burst pattern the planner
+    # exists for
+    templates = [
+        (100_000, 800_000, 0),
+        (250_000, 750_000, 1),
+        (50_000, 500_000, 2),
+        (400_000, 900_000, 3),
+        (10_000, 300_000, 4),
+        (600_000, 990_000, 5),
+    ]
+    weights = [1.0 / (i + 1) ** pool_theta for i in range(len(templates))]
+
+    def flight(rng) -> str:
+        lo, hi, row = rng.choices(templates, weights=weights)[0]
+        shared = f"Intersect(Row(v > {lo}), Row(v < {hi}), Row(f={row}))"
+        # same canonical form, different child order
+        flipped = f"Intersect(Row(f={row}), Row(v > {lo}), Row(v < {hi}))"
+        r2, r3 = rng.randrange(4), rng.randrange(8)
+        # 4 of 6 calls consume the shared subtree (>= 50% per flight)
+        return " ".join(
+            [
+                f"Count({shared})",
+                f"Count(Union({flipped}, Row(g={r2})))",
+                f"Count(Difference({shared}, Row(f={r3})))",
+                f"Count(Intersect({shared}, Row(g={r2})))",
+                f"Count(Row(f={r3}))",
+                f"Count(Row(g={r2}))",
+            ]
+        )
+
+    # one seeded stream, pre-drawn: both sides replay byte-identical
+    # flight traffic and the timed loop holds nothing but api.query
+    r = _random.Random(31)
+    flights = [flight(r) for _ in range(n_flights)]
+    calls_per_flight = 6
+
+    def run_side(enabled: bool) -> dict:
+        api = API(
+            batch_window=0.004,
+            batch_max_size=64,
+            rescache_entries=0,
+            planner_enabled=enabled,
+        )
+        try:
+            seed(api)
+            # warm with the full schedule once: all shapes compile here,
+            # single-query warm gates open, so the timed replay below is
+            # the steady state on both sides
+            for q in flights:
+                api.query("pl", q)
+            devmark = _devcost_mark()
+            t0 = time.perf_counter()
+            for q in flights:
+                api.query("pl", q)
+            wall = time.perf_counter() - t0
+            devcosts = _devcost_delta(
+                devmark,
+                f"planner({'on' if enabled else 'off'})",
+                forbid_compiles=True,
+            )
+            # the lane's isolation invariant: the result cache is pinned
+            # off, so NOTHING here is cache-served
+            rc = api.executor.rescache.snapshot()
+            if rc["entries"] != 0 or rc["hits"] != 0:
+                raise RuntimeError(
+                    f"planner lane: rescache leaked into the measurement "
+                    f"(entries={rc['entries']} hits={rc['hits']})"
+                )
+            return {
+                "qps": n_flights * calls_per_flight / wall,
+                "devledger": devcosts,
+                "planner": api.executor.planner.snapshot(),
+            }
+        finally:
+            api.close()
+
+    on = run_side(True)
+    off = run_side(False)
+    ratio = round(on["qps"] / off["qps"], 2) if off["qps"] else None
+    psnap = on["planner"]
+    return {
+        "planner_on_qps": round(on["qps"], 1),
+        "planner_off_qps": round(off["qps"], 1),
+        "planner_on_vs_off": ratio,
+        # planner accounting on the on side (warm + timed replays):
+        # every flight shares one canonical subtree 4 ways, so hits
+        # run ~3 per flight
+        "cse_hits": psnap["cseHits"],
+        "cse_shared": psnap["cseShared"],
+        "reorders": psnap["reorders"],
+        "lane_overrides": psnap["laneOverrides"],
+        "planner_errors": psnap["errors"],
+        "devledger_on": on["devledger"],
+        "devledger_off": off["devledger"],
+        "rescache_entries": 0,
+        "pass_ratio": ratio is not None and ratio >= 1.5,
+    }
+
+
 def _np_bsi_lt(planes, exists, sign, value, depth):
     """CPU baseline: the same bit-sliced scan in vectorized numpy."""
     lt = np.zeros_like(exists)
@@ -1299,6 +1441,16 @@ def main() -> None:
     except Exception as e:
         print(f"warning: rescache lane failed: {e}", file=sys.stderr)
 
+    # -- flight planner lane: zipfian repeat-heavy flights whose calls
+    # share canonical subtrees, planner on vs off over identical data
+    # with the result cache pinned off on both sides — the speedup is
+    # cross-query CSE, not caching
+    planner_lane = None
+    try:
+        planner_lane = _planner_lane()
+    except Exception as e:
+        print(f"warning: planner lane failed: {e}", file=sys.stderr)
+
     # -- SLO harness lane: a short seeded mixed-workload burst through
     # the full HTTP path with the server's error-budget tracker live
     # (tools/loadharness.py is the long-form version; this lane pins the
@@ -1313,6 +1465,12 @@ def main() -> None:
             [
                 loadgen.StageSpec("warm", 1.0, 60.0, 4),
                 loadgen.StageSpec("mix", 2.0, 120.0, 8),
+                # shared-subtree dashboard flights: the stage's report
+                # entry carries the flight planner's per-stage
+                # cseHits/reorders deltas (docs/serving.md)
+                loadgen.StageSpec(
+                    "sharedflight", 1.0, 80.0, 4, shared_pool=6
+                ),
             ],
             nodes=1,
             cluster_kwargs={
@@ -1839,6 +1997,11 @@ def main() -> None:
             (rescache_lane or {}).get("rescache_hit_vs_uncached")
         ),
         "rescache_hit_p50_ms": ((rescache_lane or {}).get("hit_p50_ms")),
+        # flight planner lane: planner-on/off qps >= 1.5x on shared-
+        # subtree flights with the result cache off is the planner's
+        # bar (docs/serving.md "Flight planning")
+        "planner": planner_lane,
+        "planner_on_vs_off": ((planner_lane or {}).get("planner_on_vs_off")),
         "probe": _PROBE_ATTEMPTS,
         "probe_warnings": _PROBE_WARNINGS,
         "forced_cpu": _FORCED_CPU,

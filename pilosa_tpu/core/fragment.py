@@ -143,6 +143,11 @@ class Fragment:
         self._word_delta_n = 0
         self._word_delta_compact_at = 0
         self._counts: np.ndarray | None = None  # per-slot cached popcounts
+        # (epoch, version)-keyed storage-shape stats (container_profile):
+        # /debug/fragments and the flight planner's cost model read these
+        # per request, so they must not rescan roaring containers while
+        # the fragment is unchanged
+        self._container_profile: tuple | None = None
         # Monotonic mutation counter: cheap cache key for stacked-tensor
         # caches built over this fragment (executor batch fast path).
         self.version = 0
@@ -1199,6 +1204,45 @@ class Fragment:
             if not parts:
                 return np.array([], dtype=np.uint64)
             return np.concatenate(parts)
+
+    def container_profile(self, containers: bool = True) -> dict:
+        """Storage-shape stats — set-bit total, bit density, and (when
+        ``containers``) the roaring container census — cached under the
+        fragment's ``(epoch, version)`` mutation pair, so repeat readers
+        (``/debug/fragments``, the flight planner's selectivity model)
+        pay a dict lookup instead of a rescan while the fragment is
+        unchanged.  ``containers=False`` skips the O(bits) position
+        unpack the census needs — the planner prices subtrees on every
+        flight, and write-heavy workloads bump versions too often to
+        amortize a census per flight; the census is computed lazily and
+        folded into the same cached dict on the first full request.
+        The whole compute runs under the fragment lock (RLock; the
+        helpers retake it) so the cached stats always describe exactly
+        one version."""
+        from pilosa_tpu.storage import roaring
+
+        with self._lock:
+            key = (self.epoch, self.version)
+            cached = self._container_profile
+            if cached is not None and cached[0] == key:
+                prof = cached[1]
+            else:
+                bits = self.total_count()
+                prof = {
+                    "bits": bits,
+                    "rows": len(self._slot_of),
+                    "density": (
+                        bits / (len(self._slot_of) * self.shard_width)
+                        if self._slot_of
+                        else 0.0
+                    ),
+                }
+                self._container_profile = (key, prof)
+            if containers and "containers" not in prof:
+                prof["containers"] = roaring.container_stats(
+                    self.all_positions()
+                )
+            return prof
 
     # -- anti-entropy blocks (reference fragment.go:1760-1991) --------------
 

@@ -41,6 +41,7 @@ from jax import lax
 from pilosa_tpu.core import timequantum
 from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec import planner as planner_mod
 from pilosa_tpu.obs import devledger
 from pilosa_tpu.pql.ast import Call, Condition
 
@@ -109,6 +110,13 @@ def match_tree(
     argument order); None when any node falls outside the compilable set
     (BSI conditions, Shift, keyed rows...)."""
     name = call.name
+    if name == planner_mod.SHARED:
+        # flight-planner graft (exec/planner.py): the subtree is already
+        # a materialized host row.  Declining the compiled path here is
+        # the POINT of the graft — the consumer combines it with cheap
+        # host segment algebra instead of re-launching the whole tree.
+        # (Any unknown name declines anyway; this spells the contract.)
+        return None
     if name == "Row":
         fname = call.field_arg()
         field = _stackable_field(idx, fname)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 import weakref
 from datetime import datetime
 from typing import Any
@@ -40,6 +41,7 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.exec import planner as planner_mod
 from pilosa_tpu.exec import rescache
 from pilosa_tpu.exec.result import (
     FieldRow,
@@ -62,6 +64,10 @@ _UNSET = object()
 # the BSI predicate/aggregate dispatches that don't funnel through the
 # kernels dispatch notes (those book under ops.kernels / ops.bsi).
 _DL_STACK = devledger.site("executor.stack_launch")
+# pair-count gram/scan answers: the per-item measured price the flight
+# planner's lane chooser weighs against the host latency tier
+# (exec/planner.py)
+_DL_PAIR = devledger.site("executor.pair_counts")
 
 # Largest stacked [S, R, W] tensor the batch fast path will materialize.
 _STACK_BUDGET_BYTES = 4 << 30  # device serving stacks; tuned for v5e HBM
@@ -127,9 +133,16 @@ class Executor:
         rescache_entries: int = 512,
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
+        planner_enabled: bool = True,
     ):
         self.holder = holder
         self.translator = translator or TranslateStore()
+        # flight-level query planner (exec/planner.py, docs/serving.md
+        # "Flight planning"): cross-query CSE + cost-based reordering +
+        # measured lane choice, applied per execute_batch shard group
+        self.planner = planner_mod.FlightPlanner(
+            self, enabled=planner_enabled
+        )
         # semantic result cache (exec/rescache.py, docs/caching.md):
         # translated read calls keyed by canonical AST + fragment version
         # vector, probed ahead of the batch fast paths; 0 entries
@@ -290,6 +303,13 @@ class Executor:
                     )
                     if res is not rescache.MISS:
                         flat_results[fi] = res
+                # flight planning AFTER the cache probe (tokens and keys
+                # are captured; grafts/reorders cannot shift identity)
+                # and BEFORE the batch passes (grafted trees must fall
+                # to host segment algebra, which is the sharing win)
+                self.planner.plan_group(
+                    idx, flat_calls, shards, flat_results, _UNSET
+                )
                 self._batch_pair_counts(idx, flat_calls, shards, flat_results)
                 self._batch_general(idx, flat_calls, shards, flat_results)
                 self._batch_bsi(idx, flat_calls, shards, flat_results)
@@ -820,14 +840,18 @@ class Executor:
         a serving stack is already live (answering from it beats the
         per-fragment path, and repeat singles then install + hit the
         cached host gram: zero device work per query) or when repeat
-        singles against this field prove reuse."""
+        singles against this field prove reuse.  Once the cost ledger
+        has priced both lanes, the measured comparison replaces the
+        warm-up counter (exec/planner.py lane choice)."""
         if self._stack_cached(field, shard_list):
             return True
         lock = vars(field).setdefault("_stack_lock", threading.RLock())
         with lock:
             n = vars(field).get("_pair_single_demand", 0) + 1
             field._pair_single_demand = n
-        return n >= self._PAIR_SINGLE_WARM
+        return self.planner.choose_lane(
+            "pair_count", n >= self._PAIR_SINGLE_WARM
+        )
 
     @staticmethod
     def _stack_entry_for(field: Field, bits):
@@ -1054,7 +1078,9 @@ class Executor:
             uniq = sorted({s for _, _, sa, sb in launch for s in (sa, sb)})
             with tracing.start_span("executor.batchPairCount").set_tag(
                 "field", fname
-            ).set_tag("n", len(launch)):
+            ).set_tag("n", len(launch)), _DL_PAIR.launch(
+                sig=f"gram n{len(launch)}", n=len(launch)
+            ):
                 gram, pos = self._field_gram(field, bits, uniq)
                 if gram is not None:
                     pa = np.array([pos[sa] for _, _, sa, _ in launch])
@@ -1266,8 +1292,13 @@ class Executor:
                         stacks_by_view[pair] = None
                     elif field.view(vname) is None:
                         stacks_by_view[pair] = _ABSENT
-                    elif demand.get(pair, 0) >= 2 or self._stack_cached(
+                    elif self._stack_cached(
                         field, shard_list, vname
+                    ) or self.planner.choose_lane(
+                        # live stack: serving from it is free.  Cold:
+                        # the >= 2 demand heuristic stands until the
+                        # ledger prices the batch-vs-solo lanes.
+                        "tree_count", demand.get(pair, 0) >= 2
                     ):
                         stacks_by_view[pair] = self._field_stack(
                             field, shard_list, view_name=vname
@@ -1850,6 +1881,11 @@ class Executor:
 
     def _bitmap_call(self, idx: Index, call: Call, shards: list[int]) -> Row:
         name = call.name
+        if name == planner_mod.SHARED:
+            # flight-shared operand (exec/planner.py): the row was
+            # materialized once for the whole flight; copy like a cache
+            # hit so consumers can attach keys/attrs independently
+            return rescache.copy_result(planner_mod.shared_row(call))
         if name in ("Row", "Range"):
             return self._execute_row(idx, call, shards)
         if name == "Difference":
@@ -1869,12 +1905,17 @@ class Executor:
     def _combine(self, idx: Index, call: Call, shards: list[int], op: str) -> Row:
         if op == "intersect" and not call.children:
             raise ExecuteError("empty Intersect query is currently not supported")
-        rows = [self._bitmap_call(idx, c, shards) for c in call.children]
-        if not rows:
+        if not call.children:
             return Row(n_words=idx.n_words)
-        out = rows[0]
-        for r in rows[1:]:
-            out = getattr(out, op)(r)
+        # children evaluate lazily so an Intersect whose running result
+        # is provably empty (no populated segments — the planner sorts
+        # sparse operands first, exec/planner.py) skips the remaining
+        # subtrees entirely
+        out = self._bitmap_call(idx, call.children[0], shards)
+        for c in call.children[1:]:
+            if op == "intersect" and not out.segments:
+                break
+            out = getattr(out, op)(self._bitmap_call(idx, c, shards))
         return out
 
     def _execute_not(self, idx: Index, call: Call, shards: list[int]) -> Row:
@@ -2235,7 +2276,14 @@ class Executor:
         if m is not None:
             fname, op, ra, rb = m
             view = idx.field(fname).view(VIEW_STANDARD)
-            return self._host_pair_count(view, ra, rb, op, shard_list)
+            t0 = time.perf_counter()
+            total = self._host_pair_count(view, ra, rb, op, shard_list)
+            # host-lane price note: what the lane chooser weighs against
+            # the ledger's measured gram cost (exec/planner.py)
+            self.planner.note_host_lane(
+                "pair_count", (time.perf_counter() - t0) * 1e3
+            )
+            return total
         n = self._match_single_row_count(idx, child)
         if n is not None:
             field, row_id = n
@@ -2247,6 +2295,18 @@ class Executor:
                     view, row_id, row_id, "intersect", shard_list
                 )
             return 0
+        if child.name in (
+            "Intersect", "Union", "Difference", "Xor", "Not"
+        ) and not planner_mod.contains_shared(child):
+            # solo host evaluation of a full tree: the batch-vs-solo
+            # host-lane price (post-CSE combines are excluded — a
+            # grafted tree is not a solo-evaluation sample)
+            t0 = time.perf_counter()
+            total = self._bitmap_call(idx, child, shard_list).count()
+            self.planner.note_host_lane(
+                "tree_count", (time.perf_counter() - t0) * 1e3
+            )
+            return total
         return self._bitmap_call(idx, child, shard_list).count()
 
     @staticmethod

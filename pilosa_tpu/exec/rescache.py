@@ -111,6 +111,17 @@ def canonical_str(call: Call) -> str:
     return f"{call.name}({', '.join(parts)})"
 
 
+def subtree_key(idx: Index, call: Call) -> str | None:
+    """Canonical CSE key for one subtree, or None when the subtree is
+    not safely shareable — the exact cacheability rules whole-call
+    entries use (recognized read-only shapes, no attr args), so a
+    flight-shared operand (exec/planner.py) is valid under precisely
+    the per-fragment version vector a cache entry would carry."""
+    if collect_fields(idx, call) is None:
+        return None
+    return canonical_str(call)
+
+
 def collect_fields(idx: Index, call: Call) -> set[str] | None:
     """The field names a call can read, or None when the call shape is
     not cacheable.  Conservative: an unrecognized name anywhere in the

@@ -61,6 +61,14 @@ class StageSpec:
     exercises the semantic result cache, whose per-stage hit/
     invalidation deltas land in the report entry (docs/caching.md).
 
+    ``shared_pool`` (template count) switches the stage's reads to the
+    shared-subtree flight generator (``WorkloadGenerator.
+    sequence_shared``): each read is one multi-call query whose calls
+    embed a common canonical subtree, the shape the flight planner's
+    cross-query CSE exists for — the stage's report entry carries the
+    planner's per-stage cseHits/reorders deltas (docs/serving.md
+    "Flight planning").
+
     ``tenant`` stamps every request of the stage with an
     ``X-Pilosa-Tenant`` header, so the stage's device work lands under
     that principal in the device cost ledger (docs/observability.md);
@@ -77,6 +85,7 @@ class StageSpec:
         device_budget: int | None = None,
         repeat_pool: int | None = None,
         tenant: str | None = None,
+        shared_pool: int | None = None,
     ):
         self.name = name
         self.duration = float(duration)
@@ -88,6 +97,7 @@ class StageSpec:
         )
         self.repeat_pool = int(repeat_pool) if repeat_pool else None
         self.tenant = str(tenant) if tenant else None
+        self.shared_pool = int(shared_pool) if shared_pool else None
 
     @property
     def op_count(self) -> int:
@@ -103,6 +113,7 @@ class StageSpec:
             "deviceBudget": self.device_budget,
             "repeatPool": self.repeat_pool,
             "tenant": self.tenant,
+            "sharedPool": self.shared_pool,
         }
 
 
@@ -283,6 +294,28 @@ def _rescache_delta(before: dict | None, after: dict | None) -> dict | None:
     return delta
 
 
+def _planner_counters(base: str) -> dict | None:
+    """Monotonic flight-planner counters from /debug/vars, for per-stage
+    delta arithmetic (None when the node predates the planner)."""
+    dbg = _fetch_json(base, "/debug/vars")
+    if not dbg or "planner" not in dbg:
+        return None
+    pl = dbg.get("planner") or {}
+    return {
+        "cseHits": pl.get("cseHits", 0),
+        "cseShared": pl.get("cseShared", 0),
+        "reorders": pl.get("reorders", 0),
+        "laneOverrides": pl.get("laneOverrides", 0),
+        "errors": pl.get("errors", 0),
+    }
+
+
+def _planner_delta(before: dict | None, after: dict | None) -> dict | None:
+    if before is None or after is None:
+        return None
+    return {k: after[k] - before[k] for k in before}
+
+
 def _devcost_counters(base: str) -> dict | None:
     """Monotonic device-cost-ledger totals from /debug/devcosts,
     flattened for per-stage delta arithmetic (None when the node
@@ -358,16 +391,19 @@ class LoadHarness:
         generator stream spans the stages so the whole run replays from
         the seed."""
         gen = WorkloadGenerator(self.config)
-        return [
-            (
-                gen.sequence_repeat(
+
+        def _stage_ops(st: StageSpec) -> list:
+            if st.shared_pool:
+                return gen.sequence_shared(
+                    st.op_count, st.mix, pool_size=st.shared_pool
+                )
+            if st.repeat_pool:
+                return gen.sequence_repeat(
                     st.op_count, st.mix, pool_size=st.repeat_pool
                 )
-                if st.repeat_pool
-                else gen.sequence(st.op_count, st.mix)
-            )
-            for st in self.stages
-        ]
+            return gen.sequence(st.op_count, st.mix)
+
+        return [_stage_ops(st) for st in self.stages]
 
     def run(self) -> dict:
         per_stage_ops = self.generate()
@@ -387,6 +423,7 @@ class LoadHarness:
             # accounted and the shrink evicts the live working set.
             res_before = _residency_counters(self.uris[0])
             rc_before = _rescache_counters(self.uris[0])
+            pl_before = _planner_counters(self.uris[0])
             dc_before = _devcost_counters(self.uris[0])
             prev_cap: tuple | None = None
             if stage.device_budget is not None:
@@ -472,6 +509,9 @@ class LoadHarness:
                     "rescache": _rescache_delta(
                         rc_before, _rescache_counters(self.uris[0])
                     ),
+                    "planner": _planner_delta(
+                        pl_before, _planner_counters(self.uris[0])
+                    ),
                     "devcosts": _devcost_delta(
                         dc_before, _devcost_counters(self.uris[0])
                     ),
@@ -494,6 +534,9 @@ class LoadHarness:
         rescache = None
         if final_vars and "rescache" in final_vars:
             rescache = final_vars.get("rescache")
+        planner = None
+        if final_vars and "planner" in final_vars:
+            planner = final_vars.get("planner")
         # end-of-run ledger state: per-site and per-principal accounting
         # (the tenant-labeled stages show up as principals here)
         devcosts = _fetch_json(self.uris[0], "/debug/devcosts")
@@ -511,6 +554,7 @@ class LoadHarness:
             events=events,
             residency=residency,
             rescache=rescache,
+            planner=planner,
             devcosts=devcosts,
         )
 

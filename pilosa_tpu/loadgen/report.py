@@ -37,6 +37,7 @@ def build_report(
     events: dict | None = None,
     residency: dict | None = None,
     rescache: dict | None = None,
+    planner: dict | None = None,
     devcosts: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
@@ -110,6 +111,11 @@ def build_report(
         # repeat-heavy stage in the plan, the per-stage entries carry
         # the hit/invalidation deltas observed while it ran
         "rescache": rescache,
+        # end-of-run flight-planner snapshot (docs/serving.md "Flight
+        # planning"); with a shared-subtree stage in the plan, the
+        # per-stage entries carry the cseHits/reorders deltas observed
+        # while it ran
+        "planner": planner,
         # end-of-run device cost ledger (docs/observability.md): per-site
         # compile/launch/transfer accounting plus per-principal rows —
         # tenant-labeled stages (StageSpec.tenant) land here under their

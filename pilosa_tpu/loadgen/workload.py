@@ -358,6 +358,71 @@ class WorkloadGenerator:
                 out.append(self._build(kind))
         return out
 
+    def sequence_shared(
+        self,
+        n: int,
+        mix: dict[str, float] | None = None,
+        pool_size: int = 8,
+        pool_theta: float | None = None,
+    ) -> list[Op]:
+        """The next ``n`` ops with reads replaced by shared-subtree
+        FLIGHTS: each read op is one multi-call query whose calls embed
+        a common canonical subtree (one occurrence commutatively
+        flipped), so calls landing in one server-side batch group are
+        the flight planner's CSE shape (docs/serving.md "Flight
+        planning").  The shared subtrees carry a BSI condition, keeping
+        them off the compiled count path — the dashboard burst where
+        planning pays.  Flights are drawn zipfian from ``pool_size``
+        pre-built templates; writes still randomize from the mix.
+        Deterministic like :meth:`sequence`."""
+        weights = dict(self.config.mix if mix is None else mix)
+        read_weights = {
+            k: w
+            for k, w in weights.items()
+            if OP_CLASS[k].startswith("read.") and w > 0
+        }
+        if not read_weights:
+            return self.sequence(n, mix)
+        rng = self._rng
+        pool: list[Op] = []
+        for _ in range(max(1, int(pool_size))):
+            r = self._row_zipf.sample(rng)
+            r2 = self._row_zipf.sample(rng)
+            b = int(rng.integers(BSI_VAL_MIN, BSI_VAL_MAX))
+            shared = f"Intersect(Row({BSI_FIELD} > {b}), Row(seg={r}))"
+            # same canonical form, different child order
+            flipped = f"Intersect(Row(seg={r}), Row({BSI_FIELD} > {b}))"
+            # 4 of 6 calls consume the shared subtree (>= 50% per flight)
+            flight = " ".join(
+                [
+                    f"Count({shared})",
+                    f"Count(Union({flipped}, Row(seg={r2})))",
+                    f"Count(Difference({shared}, Row(seg={r2})))",
+                    f"Count(Intersect({shared}, Row(seg={r2})))",
+                    f"Count(Row(seg={r2}))",
+                    f"Count(Row(seg={r}))",
+                ]
+            )
+            pool.append(self._query_op("count", self.config.index, flight))
+        pool_zipf = Zipf(
+            len(pool),
+            self.config.zipf_theta if pool_theta is None else pool_theta,
+        )
+        kinds = sorted(weights)
+        p = np.array([weights[k] for k in kinds], dtype=np.float64)
+        if p.sum() <= 0:
+            raise ValueError("mix weights must sum > 0")
+        p /= p.sum()
+        choices = self._rng.choice(len(kinds), size=n, p=p)
+        out: list[Op] = []
+        for i in choices:
+            kind = kinds[i]
+            if kind in read_weights:
+                out.append(pool[pool_zipf.sample(rng)])
+            else:
+                out.append(self._build(kind))
+        return out
+
 
 def schema_ops(config: WorkloadConfig) -> list[tuple[str, str, dict]]:
     """Schema the workload needs, as (kind, name, options) steps the

@@ -213,7 +213,7 @@ class Site:
     through the owning ledger's lock except window bookkeeping, which is
     thread-local until the window exits."""
 
-    __slots__ = ("name", "ledger", "acc", "_seen", "recent_sigs")
+    __slots__ = ("name", "ledger", "acc", "_seen", "recent_sigs", "sig_ms")
 
     def __init__(self, name, ledger):
         self.name = name
@@ -221,6 +221,11 @@ class Site:
         self.acc = _Accum()
         self._seen = set()  # tracked callable/shape identities
         self.recent_sigs = deque(maxlen=8)
+        # sig class (first token of the launch sig, e.g. "count" of
+        # "count B8 S4") -> [launches, EWMA device-ms per launch]: the
+        # measured price list the flight planner's lane chooser reads
+        # instead of hardcoded warm-up heuristics (exec/planner.py)
+        self.sig_ms: dict[str, list] = {}
 
     # -- identity tracking ------------------------------------------------
     def track(self, fn, key=()) -> bool:
@@ -276,7 +281,13 @@ class Site:
             if not muted:
                 if w.compiles:
                     self.ledger._book_compile(self, w.compiles, w.compile_ms, sig)
-                self.ledger._book_launch(self, n, wall_ms, wall_ms)
+                # compile-carrying windows stay out of the per-sig price
+                # list: the lane chooser wants the steady-state launch
+                # cost, not the one-time trace+compile spike
+                self.ledger._book_launch(
+                    self, n, wall_ms, wall_ms,
+                    sig=None if w.compiles else sig,
+                )
 
     def claim(self, sig=None):
         """Adopt compile events this thread saw since the last claim —
@@ -357,6 +368,20 @@ class Ledger:
     def mark_warm(self):
         self._warm_mark = True
 
+    def measured_ms(self, site_name, sig_class):
+        """(launches, EWMA device-ms per launch) for one site's sig class,
+        or None before any non-compiling launch booked there — the flight
+        planner's lane chooser treats None as "no price yet, keep the
+        heuristic" (exec/planner.py)."""
+        with self._lock:
+            s = self._sites.get(site_name)
+            if s is None:
+                return None
+            row = s.sig_ms.get(str(sig_class))
+            if row is None:
+                return None
+            return (row[0], row[1])
+
     @property
     def warm(self) -> bool:
         if self._warm_mark:
@@ -371,6 +396,7 @@ class Ledger:
                 s.acc = _Accum()
                 s._seen.clear()
                 s.recent_sigs.clear()
+                s.sig_ms.clear()
             self._principals.clear()
             self.totals = _Accum()
             self.unattributed = _Accum()
@@ -412,12 +438,26 @@ class Ledger:
                 row.compile_ms += ms * w
         self._note_storm(site.name, sig, n)
 
-    def _book_launch(self, site, n, wall_ms, device_ms):
+    # per-site sig-class price rows kept (first-come; real sig vocabularies
+    # are a handful of op classes) and the EWMA smoothing factor
+    _MAX_SIG_CLASSES = 32
+    _SIG_EWMA_ALPHA = 0.25
+
+    def _book_launch(self, site, n, wall_ms, device_ms, sig=None):
         weights = ambient_weights()
         with self._lock:
             site.acc.launches += n
             site.acc.launch_ms += wall_ms
             site.acc.device_ms += device_ms
+            if sig is not None:
+                cls = str(sig).split(None, 1)[0]
+                row = site.sig_ms.get(cls)
+                per = device_ms / max(n, 1)
+                if row is not None:
+                    row[0] += n
+                    row[1] += self._SIG_EWMA_ALPHA * (per - row[1])
+                elif len(site.sig_ms) < self._MAX_SIG_CLASSES:
+                    site.sig_ms[cls] = [n, per]
             self.totals.launches += n
             self.totals.launch_ms += wall_ms
             self.totals.device_ms += device_ms
@@ -620,6 +660,11 @@ class Ledger:
                 d["trackedIdentities"] = len(s._seen)
                 if s.recent_sigs:
                     d["recentCompileSigs"] = list(s.recent_sigs)
+                if s.sig_ms:
+                    d["measuredMs"] = {
+                        cls: {"launches": row[0], "ewmaMs": round(row[1], 4)}
+                        for cls, row in sorted(s.sig_ms.items())
+                    }
                 sites[name] = d
             principals = []
             for (tenant, idx, cls), row in sorted(self._principals.items()):
@@ -756,6 +801,10 @@ def prometheus_text() -> str:
 
 def reset() -> None:
     _LEDGER.reset()
+
+
+def measured_ms(site_name, sig_class):
+    return _LEDGER.measured_ms(site_name, sig_class)
 
 
 def mark_warm() -> None:

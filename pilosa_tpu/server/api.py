@@ -86,6 +86,7 @@ class API:
         rescache_entries: int = 512,
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
+        planner_enabled: bool = True,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -100,6 +101,7 @@ class API:
             rescache_entries=rescache_entries,
             rescache_promote_hits=rescache_promote_hits,
             rescache_demote_deltas=rescache_demote_deltas,
+            planner_enabled=planner_enabled,
         )
         # Cluster-aware execution path (reference executor.go mapReduce);
         # collapses to the local executor on a single node.
@@ -1139,16 +1141,19 @@ class API:
                             res_heat = round(tracker.heat_of(frag), 3)
                         store = frag.store
                         last_snap = getattr(store, "last_snapshot_at", None)
+                        # version-cached storage stats: repeat /debug/
+                        # fragments polls (and the flight planner, which
+                        # shares this cache) stop rescanning containers
+                        # while the fragment is unchanged
+                        prof = frag.container_profile()
                         d = {
                             "index": iname,
                             "field": fname,
                             "view": vname,
                             "shard": shard,
                             "rows": rows,
-                            "bits": frag.total_count(),
-                            "containers": roaring.container_stats(
-                                frag.all_positions()
-                            ),
+                            "bits": prof["bits"],
+                            "containers": prof["containers"],
                             "hostBytes": host_bytes,
                             "deviceResident": device_resident,
                             "deviceBytes": device_bytes,

@@ -353,10 +353,33 @@ class QueryBatcher:
                 (p, n / len(items)) for p, n in counts.items()
             ]
             t0 = time.perf_counter()
+            # window-close planning runs inside execute_batch (after the
+            # cache probe, before the batched passes); snapshotting the
+            # planner's monotonic counters around the dispatch turns
+            # them into per-flight deltas on the shared profile
+            # the batcher may wrap the DistributedExecutor facade; the
+            # planner lives on the local Executor either way
+            pl = getattr(self.executor, "planner", None) or getattr(
+                getattr(self.executor, "local", None), "planner", None
+            )
+            before = (
+                (pl.cse_hits, pl.cse_shared, pl.reorders, pl.lane_overrides)
+                if pl is not None
+                else None
+            )
             with qprofile.activate(prof), devledger.weighted_scope(weights):
                 outs = self.executor.execute_batch(
                     index, [(item.query, item.shards) for item in items]
                 )
+                if prof is not None and before is not None:
+                    qprofile.annotate(
+                        "planner.flight",
+                        0.0,
+                        cseHits=pl.cse_hits - before[0],
+                        cseShared=pl.cse_shared - before[1],
+                        reorders=pl.reorders - before[2],
+                        laneOverrides=pl.lane_overrides - before[3],
+                    )
             prof_dict = None
             if prof is not None:
                 prof.finish(time.perf_counter() - t0)

@@ -349,6 +349,10 @@ class Handler(BaseHTTPRequestHandler):
             # promotion state of the maintained TopN/GroupBy views
             # (exec/rescache.py)
             snap["rescache"] = ex.rescache.snapshot()
+            # flight planner: CSE sharing, reorder, and measured lane
+            # decisions, plus both lanes' live price list
+            # (exec/planner.py)
+            snap["planner"] = ex.planner.snapshot()
         from pilosa_tpu.core import membudget, residency, translate
         from pilosa_tpu.ops import kernels
 

@@ -177,6 +177,7 @@ class NodeServer:
         rescache_entries: int = 512,
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
+        planner_enabled: bool = True,
         slo_objectives: dict | None = None,
         slo_burn_rules: list[dict] | None = None,
         slo_slot_seconds: float | None = None,
@@ -308,6 +309,7 @@ class NodeServer:
             rescache_entries=rescache_entries,
             rescache_promote_hits=rescache_promote_hits,
             rescache_demote_deltas=rescache_demote_deltas,
+            planner_enabled=planner_enabled,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

@@ -47,6 +47,7 @@ class InProcessCluster:
         rescache_entries: int = 512,
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
+        planner_enabled: bool = True,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -75,6 +76,7 @@ class InProcessCluster:
             "rescache_entries": rescache_entries,
             "rescache_promote_hits": rescache_promote_hits,
             "rescache_demote_deltas": rescache_demote_deltas,
+            "planner_enabled": planner_enabled,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

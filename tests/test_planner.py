@@ -1,0 +1,364 @@
+"""Flight-level query planner (exec/planner.py) — unit behavior plus
+the property that matters: the planner is INVISIBLE.  A planned executor
+and an unplanned twin over the same holder must return bit-identical
+results for randomized flights of commutative ASTs with shared
+subtrees, including write-interleaved rounds (the shared operand is
+evaluated through the rescache version-vector machinery, so a write
+landing between flights must be observed by the very next flight)."""
+
+import random
+
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import planner, rescache
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.obs import devledger, qprofile
+from pilosa_tpu.pql import parse
+
+SEED = 20260806
+
+
+def _twins():
+    """(planned, unplanned) executors over ONE holder; rescache pinned
+    off on both so equivalence exercises the planner, not the cache."""
+    h = Holder()
+    idx = h.create_index("i", track_existence=True)
+    idx.create_field("a")
+    idx.create_field("b")
+    idx.create_field("v", FieldOptions(field_type="int", min_=0, max_=200))
+    on = Executor(h, rescache_entries=0)
+    off = Executor(h, rescache_entries=0, planner_enabled=False)
+    return on, off
+
+
+def _norm(results):
+    return [result_to_json(r) for r in results]
+
+
+def _norm_batch(outs):
+    normed = []
+    for out in outs:
+        if isinstance(out, BaseException):
+            normed.append(("err", type(out).__name__, str(out)))
+        else:
+            normed.append(_norm(out))
+    return normed
+
+
+class TestCSE:
+    def test_shared_subtree_counted_and_equivalent(self):
+        on, off = _twins()
+        on.execute(
+            "i",
+            "Set(1, a=1) Set(2, a=1) Set(3, a=2) "
+            "Set(1, b=1) Set(4, b=1) Set(2, b=2)",
+        )
+        qs = [
+            ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+            ("Count(Union(Intersect(Row(a=1), Row(b=1)), Row(b=2)))", None),
+            # commutative flip: same canonical subtree
+            ("Intersect(Row(b=1), Row(a=1))", None),
+        ]
+        got = _norm_batch(on.execute_batch("i", qs))
+        want = _norm_batch(off.execute_batch("i", qs))
+        assert got == want
+        assert on.planner.cse_shared >= 1
+        # three occurrences of one canonical form -> one evaluation,
+        # two consumers served from the shared row
+        assert on.planner.cse_hits >= 2
+        assert off.planner.cse_hits == 0
+
+    def test_full_call_shared_top_level(self):
+        on, off = _twins()
+        on.execute("i", "Set(1, a=1) Set(1, b=1) Set(2, b=1)")
+        qs = [
+            ("Intersect(Row(a=1), Row(b=1))", None),
+            ("Intersect(Row(a=1), Row(b=1))", None),
+        ]
+        got = _norm_batch(on.execute_batch("i", qs))
+        assert got == _norm_batch(off.execute_batch("i", qs))
+        assert on.planner.cse_hits >= 1
+
+    def test_shared_row_copied_per_consumer(self):
+        """Grafted consumers must not alias one mutable result object:
+        attaching attrs/keys in one query's demux can't leak into a
+        flight-mate's payload."""
+        on, _ = _twins()
+        on.execute("i", "Set(1, a=1) Set(1, b=1)")
+        qs = [
+            ("Intersect(Row(a=1), Row(b=1))", None),
+            ("Intersect(Row(a=1), Row(b=1))", None),
+        ]
+        outs = on.execute_batch("i", qs)
+        r0, r1 = outs[0][0], outs[1][0]
+        assert r0 is not r1
+        r0.attrs["poison"] = True
+        assert "poison" not in r1.attrs
+
+    def test_bad_query_does_not_sink_flight_mates(self):
+        on, off = _twins()
+        on.execute("i", "Set(1, a=1) Set(1, b=1)")
+        qs = [
+            ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+            ("Count(Intersect(Row(nosuch=1), Row(b=9)))", None),
+            ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+        ]
+        got = on.execute_batch("i", qs)
+        want = off.execute_batch("i", qs)
+        assert _norm_batch(got) == _norm_batch(want)
+        assert not isinstance(got[0], BaseException)
+        assert isinstance(got[1], BaseException)
+
+    def test_write_interleaved_shared_operand_is_fresh(self):
+        """The version-vector round: the same shared-subtree flight
+        before and after a write must observe the write — the shared
+        row is evaluated per flight under the current per-fragment
+        (epoch, version) vector, never served stale."""
+        on, off = _twins()
+        on.execute("i", "Set(1, a=1) Set(1, b=1)")
+        qs = [
+            ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+            ("Union(Intersect(Row(a=1), Row(b=1)), Row(a=2))", None),
+        ]
+        first = _norm_batch(on.execute_batch("i", qs))
+        assert first == _norm_batch(off.execute_batch("i", qs))
+        assert first[0] == [1]
+        on.execute("i", "Set(2, a=1) Set(2, b=1)")
+        second = _norm_batch(on.execute_batch("i", qs))
+        assert second == _norm_batch(off.execute_batch("i", qs))
+        assert second[0] == [2], "shared operand served stale across a write"
+
+
+class TestReorder:
+    def test_reorders_fire_and_preserve_results(self):
+        on, off = _twins()
+        # a=1 dense (many bits), b=1 sparse: cheapest-first puts b first
+        writes = " ".join(f"Set({c}, a=1)" for c in range(64))
+        on.execute("i", writes + " Set(1, b=1) Set(9, b=1)")
+        qs = [
+            ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+            ("Intersect(Row(a=1), Row(b=1), Row(a=1))", None),
+            ("Difference(Row(b=1), Row(a=1), Row(b=1))", None),
+        ] * 2
+        got = _norm_batch(on.execute_batch("i", qs))
+        want = _norm_batch(off.execute_batch("i", qs))
+        assert got == want
+        assert on.planner.reorders >= 1
+        assert off.planner.reorders == 0
+
+    def test_intersect_empty_short_circuit_correct(self):
+        on, off = _twins()
+        on.execute("i", "Set(1, a=1)")
+        # Row(b=7) is empty -> running intersect empties -> later
+        # children are skippable, result must still be exact
+        qs = [("Count(Intersect(Row(b=7), Row(a=1)))", None)] * 3
+        assert _norm_batch(on.execute_batch("i", qs)) == _norm_batch(
+            off.execute_batch("i", qs)
+        )
+
+
+class TestRandomizedEquivalence:
+    N_ROUNDS = 40
+    FLIGHT = 8
+
+    def _gen_pool(self, rng):
+        """Template pool of shared-able subtrees over fields a/b/v."""
+        pool = []
+        for _ in range(6):
+            kind = rng.randrange(4)
+            r1, r2 = rng.randrange(4), rng.randrange(4)
+            if kind == 0:
+                pool.append(f"Intersect(Row(a={r1}), Row(b={r2}))")
+            elif kind == 1:
+                pool.append(f"Union(Row(a={r1}), Row(b={r2}), Row(a={r2}))")
+            elif kind == 2:
+                pool.append(f"Difference(Row(a={r1}), Row(b={r2}))")
+            else:
+                lo = rng.randrange(0, 100)
+                pool.append(f"Intersect(Row(v > {lo}), Row(a={r1}))")
+        return pool
+
+    def _gen_query(self, rng, pool):
+        shared = rng.choice(pool)
+        k = rng.randrange(4)
+        if k == 0:
+            return f"Count({shared})"
+        if k == 1:
+            return f"Count(Union({shared}, Row(b={rng.randrange(4)})))"
+        if k == 2:
+            return f"Intersect({shared}, Row(a={rng.randrange(4)}))"
+        return f"Xor({shared}, Row(b={rng.randrange(4)}))"
+
+    def test_planned_equals_unplanned_with_writes(self):
+        rng = random.Random(SEED)
+        on, off = _twins()
+        for c in range(32):
+            on.execute(
+                "i",
+                f"Set({c}, a={c % 4}) Set({c}, b={(c * 7) % 4}) "
+                f"Set({c}, v={c * 5 % 150})",
+            )
+        pool = self._gen_pool(rng)
+        for rnd in range(self.N_ROUNDS):
+            if rng.random() < 0.3:
+                c = rng.randrange(64)
+                on.execute(
+                    "i",
+                    f"Set({c}, a={rng.randrange(4)}) "
+                    f"Set({c}, v={rng.randrange(150)})",
+                )
+            if rng.random() < 0.2:
+                pool = self._gen_pool(rng)
+            qs = [
+                (self._gen_query(rng, pool), None)
+                for _ in range(self.FLIGHT)
+            ]
+            got = _norm_batch(on.execute_batch("i", qs))
+            want = _norm_batch(off.execute_batch("i", qs))
+            assert got == want, f"seed={SEED} round={rnd} qs={qs}"
+        # the stream above is repeat-heavy by construction; planning
+        # must actually have engaged
+        assert on.planner.cse_hits > 0
+
+
+class TestLaneChooser:
+    def test_heuristic_stands_until_both_lanes_priced(self):
+        ex, _ = _twins()
+        lanes = ex.planner.lanes
+        assert lanes.prefer_device("pair_count") is None
+        assert ex.planner.choose_lane("pair_count", True) is True
+        assert ex.planner.choose_lane("pair_count", False) is False
+        assert ex.planner.lane_overrides == 0
+
+    def test_measured_prices_override_heuristic(self):
+        ex, _ = _twins()
+        lanes = ex.planner.lanes
+        site = devledger.site("executor.pair_counts")
+        devledger.ledger()._book_launch(site, 4, 0.4, 0.4, sig="gram n4")
+        for _ in range(lanes.MIN_SAMPLES):
+            lanes.note_host("pair_count", 5.0)
+        # device 0.1ms/item vs host 5ms: device wins
+        assert lanes.prefer_device("pair_count") is True
+        assert ex.planner.choose_lane("pair_count", False) is True
+        assert ex.planner.lane_overrides == 1
+        # agreeing with the heuristic is not an override
+        assert ex.planner.choose_lane("pair_count", True) is True
+        assert ex.planner.lane_overrides == 1
+
+    def test_host_lane_can_win(self):
+        ex, _ = _twins()
+        lanes = ex.planner.lanes
+        site = devledger.site("exec.astbatch")
+        devledger.ledger()._book_launch(site, 8, 80.0, 80.0, sig="count B8")
+        for _ in range(lanes.MIN_SAMPLES):
+            lanes.note_host("tree_count", 0.05)
+        assert lanes.prefer_device("tree_count") is False
+        assert ex.planner.choose_lane("tree_count", True) is False
+
+    def teardown_method(self):
+        devledger.reset()
+
+
+class TestObservability:
+    def test_profile_carries_planner_annotations(self):
+        on, _ = _twins()
+        on.execute("i", "Set(1, a=1) Set(1, b=1) Set(2, b=1)")
+        prof = qprofile.QueryProfile("i", "<batch of 2>")
+        with qprofile.activate(prof):
+            on.execute_batch(
+                "i",
+                [
+                    ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+                    ("Count(Intersect(Row(b=1), Row(a=1)))", None),
+                ],
+            )
+        prof.finish(0.01)
+        names = str(prof.to_dict())
+        assert "planner.cse" in names
+
+    def test_snapshot_shape(self):
+        on, _ = _twins()
+        snap = on.planner.snapshot()
+        for key in (
+            "enabled",
+            "cseHits",
+            "cseShared",
+            "reorders",
+            "laneOverrides",
+            "errors",
+            "lanes",
+        ):
+            assert key in snap, snap
+
+    def test_stats_series_booked(self):
+        from pilosa_tpu.obs import stats as stats_mod
+
+        on, off = _twins()
+        on.holder.set_stats(stats_mod.MemStatsClient())
+        on.execute("i", "Set(1, a=1) Set(1, b=1)")
+        on.execute_batch(
+            "i",
+            [
+                ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+                ("Count(Intersect(Row(a=1), Row(b=1)))", None),
+            ],
+        )
+        counters = on.holder.stats.snapshot()["counters"]
+        assert counters.get("planner_cse_hits", 0) >= 1, counters
+
+
+class TestSubtreeKey:
+    def test_commutative_children_share_key(self):
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("a")
+        idx.create_field("b")
+        q1 = parse("Intersect(Row(a=1), Row(b=2))").calls[0]
+        q2 = parse("Intersect(Row(b=2), Row(a=1))").calls[0]
+        assert rescache.subtree_key(idx, q1) == rescache.subtree_key(idx, q2)
+
+    def test_attr_args_poison(self):
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("a")
+        q = parse('TopN(a, attrName="x", attrValues=[1])').calls[0]
+        assert rescache.subtree_key(idx, q) is None
+
+    def test_graft_node_never_keyed_or_cached(self):
+        h = Holder()
+        idx = h.create_index("i")
+        node = planner.make_shared(object())
+        assert rescache.subtree_key(idx, node) is None
+        assert rescache.collect_fields(idx, node) is None
+
+
+class TestContainerProfile:
+    def test_cached_per_version(self):
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("a")
+        ex = Executor(h)
+        ex.execute("i", "Set(1, a=1) Set(2, a=1)")
+        frag = idx.field("a").view("standard").fragment(0)
+        p1 = frag.container_profile()
+        assert p1["bits"] == 2 and p1["containers"]["containers"] >= 1
+        # unchanged version: the SAME cached dict comes back
+        assert frag.container_profile() is p1
+        ex.execute("i", "Set(3, a=1)")
+        p2 = frag.container_profile()
+        assert p2 is not p1 and p2["bits"] == 3
+
+    def test_light_profile_defers_census(self):
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("a")
+        ex = Executor(h)
+        ex.execute("i", "Set(1, a=1)")
+        frag = idx.field("a").view("standard").fragment(0)
+        light = frag.container_profile(containers=False)
+        assert "containers" not in light and light["bits"] == 1
+        full = frag.container_profile()
+        assert full is light and "containers" in full

@@ -88,6 +88,17 @@ REPEAT_READ_MIX = {
     "range_bsi": 10.0, "set": 8.0, "set_val": 4.0,
 }
 REPEAT_POOL = 12
+# Shared-subtree flights: each read is one multi-call dashboard query
+# whose calls embed a common canonical subtree (StageSpec.shared_pool),
+# the flight planner's cross-query CSE shape — the stage's report entry
+# carries the per-stage cseHits/reorders deltas (docs/serving.md
+# "Flight planning").  Writes keep the shared operands' fragment
+# versions moving underneath.
+SHARED_FLIGHT_MIX = {
+    "count": 64.0, "row": 12.0, "range_bsi": 8.0, "set": 10.0,
+    "set_val": 6.0,
+}
+SHARED_POOL = 8
 
 
 def oversub_budget() -> int:
@@ -106,23 +117,27 @@ def oversub_budget() -> int:
 
 
 def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
-    sixth = max(1.0, duration / 6.0)
+    seventh = max(1.0, duration / 7.0)
     return [
-        StageSpec("warm", sixth, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
-        StageSpec("timequantum", sixth, rate, workers, TIMEQUANTUM_MIX),
-        StageSpec("rangescan", sixth, rate, workers, RANGE_HEAVY_MIX),
+        StageSpec("warm", seventh, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", seventh, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("rangescan", seventh, rate, workers, RANGE_HEAVY_MIX),
         StageSpec(
-            "oversubscribed", sixth, rate, workers, OVERSUB_MIX,
+            "oversubscribed", seventh, rate, workers, OVERSUB_MIX,
             device_budget=oversub_budget(),
         ),
         StageSpec(
-            "repeatread", sixth, rate, workers, REPEAT_READ_MIX,
+            "repeatread", seventh, rate, workers, REPEAT_READ_MIX,
             repeat_pool=REPEAT_POOL,
             # tenant-labeled stage: its device work lands under the
             # "dashboards" principal in the report's devcosts block
             tenant="dashboards",
         ),
-        StageSpec("ramp", sixth, rate * 1.5, workers, None),
+        StageSpec(
+            "sharedflight", seventh, rate, workers, SHARED_FLIGHT_MIX,
+            shared_pool=SHARED_POOL,
+        ),
+        StageSpec("ramp", seventh, rate * 1.5, workers, None),
     ]
 
 
@@ -204,11 +219,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.print_sequence:
         gen = WorkloadGenerator(config)
         for st in stages:
-            ops = (
-                gen.sequence_repeat(st.op_count, st.mix, pool_size=st.repeat_pool)
-                if st.repeat_pool
-                else gen.sequence(st.op_count, st.mix)
-            )
+            if st.shared_pool:
+                ops = gen.sequence_shared(
+                    st.op_count, st.mix, pool_size=st.shared_pool
+                )
+            elif st.repeat_pool:
+                ops = gen.sequence_repeat(
+                    st.op_count, st.mix, pool_size=st.repeat_pool
+                )
+            else:
+                ops = gen.sequence(st.op_count, st.mix)
             for op in ops:
                 print(json.dumps({"stage": st.name, **op.to_wire()}))
         return 0

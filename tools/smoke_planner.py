@@ -1,0 +1,134 @@
+"""CI smoke check for the flight-level query planner (docs/serving.md
+"Flight planning").
+
+Boots one real NodeServer and drives shared-subtree flights over
+actual HTTP:
+
+* a multi-call query whose calls embed one canonical subtree (with a
+  commutative flip) lands in one batch group and **CSE fires** —
+  ``planner.cseHits`` climbs in ``/debug/vars`` and the results match
+  a call-by-call replay;
+* results stay **write-fresh**: the same flight after a write to the
+  shared operand's field reflects the new bits;
+* the operator surfaces carry it: ``pilosa_planner_cse_hits`` in
+  ``/metrics``, the ``planner`` block in ``/debug/vars``, the
+  ``planner.cse`` span and the ``planner.flight`` counter-delta
+  annotation under ``?profile=true``, and per-fragment ``bits`` /
+  ``containers`` (the planner's cost stats) in ``/debug/fragments``.
+
+Exit status 0 on success; any assertion/exception fails the CI step.
+Run as ``python -m tools.smoke_planner``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def _get(uri: str) -> bytes:
+    return urllib.request.urlopen(uri, timeout=10).read()
+
+
+def _post(uri: str, body: bytes, ctype: str = "text/plain") -> bytes:
+    req = urllib.request.Request(
+        uri, data=body, headers={"Content-Type": ctype}, method="POST"
+    )
+    return urllib.request.urlopen(req, timeout=10).read()
+
+
+def main() -> int:
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(port=0, batch_window=0.002, batch_max_size=32)
+    node.start()
+    try:
+        base = node.uri
+        _post(f"{base}/index/pl", b"{}", "application/json")
+        for f in ("f", "g"):
+            _post(
+                f"{base}/index/pl/field/{f}",
+                b'{"options": {}}',
+                "application/json",
+            )
+        _post(
+            f"{base}/index/pl/query",
+            b"Set(1, f=1) Set(2, f=1) Set(3, f=2) Set(1, g=1) Set(4, g=1)",
+        )
+
+        def planner_vars() -> dict:
+            return json.loads(_get(f"{base}/debug/vars"))["planner"]
+
+        def query(q: str, profile: bool = False) -> dict:
+            suffix = "?profile=true" if profile else ""
+            return json.loads(
+                _post(f"{base}/index/pl/query{suffix}", q.encode())
+            )
+
+        # 1. a shared-subtree flight: all calls of one multi-call query
+        # flatten into a single batch group, so CSE fires without
+        # needing concurrent clients; the second occurrence is the
+        # commutative flip of the first (same canonical form)
+        flight = (
+            "Count(Intersect(Row(f=1), Row(g=1))) "
+            "Count(Union(Intersect(Row(g=1), Row(f=1)), Row(f=2))) "
+            "Intersect(Row(f=1), Row(g=1))"
+        )
+        before = planner_vars()
+        assert before["enabled"], before
+        got = query(flight, profile=True)
+        assert got["results"][0] == 1, got
+        assert got["results"][1] == 2, got
+        assert got["results"][2]["columns"] == [1], got
+        after = planner_vars()
+        assert after["cseHits"] >= before["cseHits"] + 2, (before, after)
+        assert after["cseShared"] >= before["cseShared"] + 1, (before, after)
+        assert after["errors"] == before["errors"], (before, after)
+
+        # planned results == the same calls replayed one at a time
+        # (flights of one plan nothing)
+        solo = [
+            query("Count(Intersect(Row(f=1), Row(g=1)))")["results"][0],
+            query("Count(Union(Intersect(Row(g=1), Row(f=1)), Row(f=2)))")[
+                "results"
+            ][0],
+            query("Intersect(Row(f=1), Row(g=1))")["results"][0],
+        ]
+        assert got["results"] == solo, (got["results"], solo)
+
+        # 2. write freshness: the shared operand is re-evaluated under
+        # the post-write fragment versions, never served stale
+        _post(f"{base}/index/pl/query", b"Set(4, f=1)")
+        fresh = query(flight)
+        assert fresh["results"][0] == 2, fresh
+        assert fresh["results"][2]["columns"] == [1, 4], fresh
+
+        # 3. operator surfaces
+        metrics = _get(f"{base}/metrics").decode()
+        for series in ("pilosa_planner_cse_hits", "pilosa_planner_cse_shared"):
+            assert series in metrics, f"{series} missing from /metrics"
+
+        names = json.dumps(got.get("profile", {}))
+        assert "planner.cse" in names, names[:600]
+        assert "planner.flight" in names, names[:600]
+
+        frags = json.loads(_get(f"{base}/debug/fragments"))
+        assert frags["fragments"], frags
+        for row in frags["fragments"]:
+            assert "bits" in row and "containers" in row, row
+
+        snap = planner_vars()
+        print(
+            "smoke_planner OK: "
+            f"cseHits={snap['cseHits']} cseShared={snap['cseShared']} "
+            f"reorders={snap['reorders']} "
+            f"laneOverrides={snap['laneOverrides']} errors={snap['errors']}"
+        )
+        return 0
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
