@@ -1,0 +1,12 @@
+"""Repo-root alias: ``python -m graftlint`` == ``python -m tools.graftlint``.
+
+CI and the docs use the short spelling; the implementation lives in
+tools/graftlint/.
+"""
+
+import sys
+
+from tools.graftlint.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,3 +26,31 @@ os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH", "14")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Runtime lockdep witness: every project lock allocated from here on is
+# wrapped, so the whole tier-1 run doubles as a lock-order race probe
+# (docs/robustness.md "Concurrency discipline").  Installed before test
+# modules import pilosa_tpu code so module-level locks get wrapped too.
+# Mode comes from PILOSA_LOCKWITNESS (raise | log | off), default raise.
+from pilosa_tpu.testing import lockwitness  # noqa: E402
+
+lockwitness.install()
+
+
+def pytest_terminal_summary(terminalreporter):
+    bad = lockwitness.findings()
+    if bad:
+        terminalreporter.section("lock order inversions (lockwitness)")
+        for inv in bad:
+            terminalreporter.line(
+                f"{inv['locks'][0]} <-> {inv['locks'][1]} "
+                f"[{inv['thread']}]: {inv['this_order']}; "
+                f"prior: {inv['prior_order']}"
+            )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # In raise mode an inversion already failed its test; this catches
+    # log mode and exceptions swallowed inside worker threads.
+    if lockwitness.findings() and session.exitstatus == 0:
+        session.exitstatus = 1

@@ -326,7 +326,7 @@ def test_resize_stays_online_under_concurrent_writes():
                     errors.append(e)
                 k += 1
 
-        t = threading.Thread(target=writer)
+        t = threading.Thread(target=writer, daemon=True)
         t.start()
         try:
             new = c.add_node()

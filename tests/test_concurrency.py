@@ -80,8 +80,12 @@ def test_concurrent_mixed_read_write_consistency():
             errors.append(e)
 
     threads = [
-        threading.Thread(target=writer, args=(w,)) for w in range(N_WRITERS)
-    ] + [threading.Thread(target=reader, args=(r,)) for r in range(N_READERS)]
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(N_WRITERS)
+    ] + [
+        threading.Thread(target=reader, args=(r,), daemon=True)
+        for r in range(N_READERS)
+    ]
     for t in threads:
         t.start()
     for t in threads:

@@ -146,7 +146,7 @@ def test_batcher_coalesces_concurrent_bsi_reads(setup):
             "Min(field=v)",
         ]
         threads = [
-            threading.Thread(target=worker, args=(k, q))
+            threading.Thread(target=worker, args=(k, q), daemon=True)
             for k, q in enumerate(qs)
         ]
         for t in threads:

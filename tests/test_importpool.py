@@ -33,7 +33,8 @@ def test_jobs_run_on_worker_threads_concurrently():
             return True
 
         threads = [
-            threading.Thread(target=lambda: pool.run(job)) for _ in range(2)
+            threading.Thread(target=lambda: pool.run(job), daemon=True)
+            for _ in range(2)
         ]
         for t in threads:
             t.start()
@@ -106,7 +107,10 @@ def test_concurrent_api_imports_are_serialized_safely():
             except Exception as e:  # pragma: no cover
                 errs.append(e)
 
-        threads = [threading.Thread(target=do, args=(b,)) for b in batches]
+        threads = [
+            threading.Thread(target=do, args=(b,), daemon=True)
+            for b in batches
+        ]
         for t in threads:
             t.start()
         for t in threads:

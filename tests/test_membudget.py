@@ -435,7 +435,7 @@ def test_concurrent_admit_touch_evict_storm_accounting_exact():
                     state[k2][2] = True
 
     threads = [
-        threading.Thread(target=worker, args=(ti,))
+        threading.Thread(target=worker, args=(ti,), daemon=True)
         for ti in range(n_threads)
     ]
     for t in threads:
@@ -496,7 +496,10 @@ def test_concurrent_stack_cache_hit_vs_evict_no_leak(restore_budget):
             except Exception as e:  # pragma: no cover
                 errors.append(e)
 
-    threads = [threading.Thread(target=worker, args=(ti,)) for ti in range(8)]
+    threads = [
+        threading.Thread(target=worker, args=(ti,), daemon=True)
+        for ti in range(8)
+    ]
     for t in threads:
         t.start()
     for t in threads:

@@ -322,7 +322,8 @@ def test_prefetcher_stages_and_scores_useful_under_cap(clean_residency):
                 )
 
         threads = [
-            threading.Thread(target=worker, args=(i,)) for i in range(6)
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(6)
         ]
         for t in threads:
             t.start()

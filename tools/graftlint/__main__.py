@@ -8,7 +8,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
 from tools.graftlint.engine import run
 
@@ -33,7 +35,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-passes", action="store_true", help="list passes and exit"
     )
+    ap.add_argument(
+        "--jobs", "-j", type=int, default=1, metavar="N",
+        help="run per-file passes over N worker processes (0 = cpu count); "
+        "finding order is identical to the serial run",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print per-pass cumulative time to stderr",
+    )
     args = ap.parse_args(argv)
+    if args.jobs == 0:
+        args.jobs = os.cpu_count() or 1
+    if args.jobs < 0:
+        ap.error("--jobs must be >= 0")
 
     if args.list_passes:
         from tools.graftlint.passes import ALL_PASSES
@@ -43,7 +58,10 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{p.PASS_ID:20s} {scope:12s} {p.DESCRIPTION}")
         return 0
 
-    findings = run(args.roots)
+    t0 = time.perf_counter()
+    timings: dict = {}
+    findings = run(args.roots, jobs=args.jobs, timings=timings)
+    wall = time.perf_counter() - t0
     open_findings = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
 
@@ -66,6 +84,15 @@ def main(argv: list[str] | None = None) -> int:
         else:
             with open(args.json, "w", encoding="utf-8") as fh:
                 json.dump(payload, fh, indent=2)
+
+    if args.timings:
+        for pass_id, sec in sorted(
+            timings.items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            print(f"graftlint timing: {pass_id:24s} {sec * 1e3:9.1f} ms",
+                  file=sys.stderr)
+        print(f"graftlint timing: {'TOTAL (wall)':24s} {wall * 1e3:9.1f} ms",
+              file=sys.stderr)
 
     print(
         f"graftlint: {len(open_findings)} finding(s), "

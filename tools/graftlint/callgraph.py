@@ -1,0 +1,442 @@
+"""Whole-program def/call index for project-wide graftlint passes.
+
+The per-file passes answer "is this line wrong on its own?"; the
+concurrency passes need to answer "what locks are held when this
+function is *reached*?" and "what does this thread target *transitively*
+touch?" — questions that cross file boundaries.  This module builds a
+module-qualified, class-method-aware index of every function definition
+in the linted tree plus a conservative call-edge resolver, so a pass can
+walk interprocedural paths without re-deriving scoping rules.
+
+Resolution is deliberately *under*-approximate: an edge is only created
+when the callee can be named with confidence —
+
+* ``self.m()`` / ``cls.m()``      → method ``m`` on the enclosing class
+  or a project base class (MRO approximated as depth-first base order);
+* ``f()``                         → a function nested in the caller, a
+  module-level function, a class constructor (``__init__``), or an
+  imported function (``from x import f`` / relative imports resolved);
+* ``mod.f()`` / ``pkg.mod.f()``   → a function or constructor in the
+  imported module;
+* ``Class.m()``                   → the method (unbound call);
+* ``self._attr.m()``              → ``D.m`` when some method of the
+  class assigns ``self._attr = D(...)`` for a project class ``D``;
+* ``v.m()``                       → ``D.m`` when the caller assigns
+  ``v = D(...)`` earlier in the same function.
+
+Everything else (dynamic dispatch, stdlib, third-party) resolves to
+nothing and simply truncates the walk — passes built on this graph
+report *witnessed* paths, never guessed ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+
+class FuncInfo:
+    """One function/method definition."""
+
+    __slots__ = (
+        "qualname", "module", "cls", "name", "path", "lineno", "node",
+        "parent", "nested",
+    )
+
+    def __init__(self, qualname, module, cls, name, path, lineno, node, parent):
+        self.qualname = qualname  # "module:Class.method" / "module:func"
+        self.module = module
+        self.cls = cls  # ClassInfo | None
+        self.name = name
+        self.path = path
+        self.lineno = lineno
+        self.node = node
+        self.parent = parent  # enclosing FuncInfo | None
+        self.nested: dict[str, "FuncInfo"] = {}
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<FuncInfo {self.qualname}>"
+
+
+class ClassInfo:
+    """One class definition: methods, bases, and ``self.attr = <Call>``
+    assignments (the raw material for attribute-type and lock-field
+    inference)."""
+
+    __slots__ = (
+        "qualname", "module", "name", "path", "lineno", "bases", "methods",
+        "attr_assigns", "attr_types",
+    )
+
+    def __init__(self, qualname, module, name, path, lineno, bases):
+        self.qualname = qualname  # "module:Class"
+        self.module = module
+        self.name = name
+        self.path = path
+        self.lineno = lineno
+        self.bases = bases  # dotted base expressions, unresolved
+        self.methods: dict[str, FuncInfo] = {}
+        # attr -> (ast.Call value, lineno) for every `self.attr = X(...)`
+        self.attr_assigns: dict[str, tuple[ast.Call, int]] = {}
+        self.attr_types: dict[str, "ClassInfo"] = {}  # filled post-link
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<ClassInfo {self.qualname}>"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested(body) -> "list[ast.AST]":
+    """Every node lexically in ``body`` without descending into nested
+    function/class definitions."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class CallGraph:
+    """Project-wide def/call index over graftlint's parsed-file dict."""
+
+    def __init__(self, files: dict, root: str | None = None):
+        """``files``: {path: (ast.Module, lines)} as engine.run collects.
+        ``root``: directory module names are relative to; defaults to the
+        common ancestor of every file (so the bundled corpus mini-trees
+        index exactly like the real tree)."""
+        paths = sorted(files)
+        if root is None and paths:
+            dirs = {os.path.dirname(os.path.abspath(p)) or "." for p in paths}
+            root = os.path.commonpath(list(dirs)) if dirs else "."
+        self.root = root or "."
+        self.files = files
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_path: dict[str, str] = {}
+        self.module_tree: dict[str, ast.AST] = {}
+        # module -> {local alias: dotted target ("a.b" / "a.b.name")}
+        self.imports: dict[str, dict[str, str]] = {}
+        # module -> {name: FuncInfo|ClassInfo} top-level scope
+        self.scope: dict[str, dict[str, object]] = {}
+        self._callee_cache: dict[str, list] = {}
+        for path in paths:
+            tree, _lines = files[path]
+            self._index_module(path, tree)
+        self._link_attr_types()
+
+    # -- indexing ------------------------------------------------------------
+
+    def module_name(self, path: str) -> str:
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        rel = rel.replace(os.sep, "/")
+        if rel.endswith(".py"):
+            rel = rel[:-3]
+        if rel.endswith("/__init__"):
+            rel = rel[: -len("/__init__")]
+        return rel.replace("/", ".")
+
+    def _index_module(self, path: str, tree: ast.AST) -> None:
+        module = self.module_name(path)
+        self.module_path[module] = path
+        self.module_tree[module] = tree
+        imports: dict[str, str] = {}
+        scope: dict[str, object] = {}
+        self.imports[module] = imports
+        self.scope[module] = scope
+        pkg = module.rsplit(".", 1)[0] if "." in module else ""
+
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        imports[alias.asname] = alias.name
+                    else:
+                        # `import a.b` binds `a`; resolve chains lazily
+                        imports[alias.name.split(".")[0]] = alias.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = pkg
+                    for _ in range(node.level - 1):
+                        up = up.rsplit(".", 1)[0] if "." in up else ""
+                    base = f"{up}.{base}".strip(".") if base else up
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    imports[local] = f"{base}.{alias.name}" if base else alias.name
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._add_func(module, None, None, node, path)
+                scope[node.name] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = self._add_class(module, node, path)
+                scope[node.name] = ci
+
+    def _add_func(self, module, cls, parent, node, path) -> FuncInfo:
+        if parent is not None:
+            qual = f"{parent.qualname}.{node.name}"
+        elif cls is not None:
+            qual = f"{module}:{cls.name}.{node.name}"
+        else:
+            qual = f"{module}:{node.name}"
+        fi = FuncInfo(qual, module, cls, node.name, path, node.lineno, node, parent)
+        self.functions[qual] = fi
+        if parent is not None:
+            parent.nested[node.name] = fi
+        # index nested defs (thread targets are often local closures)
+        self._index_nested(module, cls, fi, node.body, path)
+        return fi
+
+    def _index_nested(self, module, cls, parent, body, path) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(module, cls, parent, node, path)
+            elif isinstance(node, (ast.If, ast.For, ast.While, ast.With, ast.Try)):
+                for field in ("body", "orelse", "finalbody"):
+                    self._index_nested(
+                        module, cls, parent, getattr(node, field, []) or [], path
+                    )
+                for h in getattr(node, "handlers", []) or []:
+                    self._index_nested(module, cls, parent, h.body, path)
+
+    def _add_class(self, module, node, path) -> ClassInfo:
+        bases = [b for b in (_dotted(x) for x in node.bases) if b]
+        ci = ClassInfo(
+            f"{module}:{node.name}", module, node.name, path, node.lineno, bases
+        )
+        self.classes[ci.qualname] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._add_func(module, ci, None, item, path)
+                ci.methods[item.name] = fi
+                for sub in walk_no_nested(item.body):
+                    if (
+                        isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Attribute)
+                        and isinstance(sub.targets[0].value, ast.Name)
+                        and sub.targets[0].value.id == "self"
+                        and isinstance(sub.value, ast.Call)
+                    ):
+                        attr = sub.targets[0].attr
+                        ci.attr_assigns.setdefault(attr, (sub.value, sub.lineno))
+        return ci
+
+    def _link_attr_types(self) -> None:
+        for ci in self.classes.values():
+            for attr, (call, _ln) in ci.attr_assigns.items():
+                target = self._resolve_scope_name(ci.module, _dotted(call.func))
+                if isinstance(target, ClassInfo):
+                    ci.attr_types[attr] = target
+
+    # -- name resolution -----------------------------------------------------
+
+    def _resolve_scope_name(self, module: str, dotted: str | None):
+        """A dotted name in ``module``'s top-level scope → FuncInfo /
+        ClassInfo / module-name string / None."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = self.scope.get(module, {}).get(head)
+        if target is None:
+            imp = self.imports.get(module, {}).get(head)
+            if imp is None:
+                return None
+            return self._resolve_imported(imp + ("." + rest if rest else ""))
+        if not rest:
+            return target
+        if isinstance(target, ClassInfo) and "." not in rest:
+            return target.methods.get(rest)
+        return None
+
+    def _resolve_imported(self, dotted: str):
+        """Fully-dotted import target → FuncInfo / ClassInfo / module str."""
+        if dotted in self.module_path:
+            return dotted
+        if "." in dotted:
+            mod, _, name = dotted.rpartition(".")
+            # the prefix may itself be a package path of indexed modules
+            if mod in self.module_path:
+                obj = self.scope.get(mod, {}).get(name)
+                if obj is not None:
+                    return obj
+                return None
+            # one more level: a.b.Class.method
+            if "." in mod:
+                mod2, _, cls = mod.rpartition(".")
+                if mod2 in self.module_path:
+                    obj = self.scope.get(mod2, {}).get(cls)
+                    if isinstance(obj, ClassInfo):
+                        return obj.methods.get(name)
+        return None
+
+    def resolve_base(self, ci: ClassInfo, dotted: str) -> ClassInfo | None:
+        obj = self._resolve_scope_name(ci.module, dotted)
+        return obj if isinstance(obj, ClassInfo) else None
+
+    def mro(self, ci: ClassInfo) -> list[ClassInfo]:
+        """Depth-first base order (approximate MRO; good enough for
+        single-inheritance project code)."""
+        out, seen, stack = [], set(), [ci]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            bases = [self.resolve_base(c, b) for b in c.bases]
+            stack = [b for b in bases if b is not None] + stack
+        return out
+
+    def lookup_method(self, ci: ClassInfo, name: str) -> FuncInfo | None:
+        for c in self.mro(ci):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def attr_type(self, ci: ClassInfo, attr: str) -> ClassInfo | None:
+        for c in self.mro(ci):
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        return None
+
+    def _local_var_types(self, fi: FuncInfo) -> dict[str, ClassInfo]:
+        """{var: ClassInfo} for ``v = D(...)`` assignments in ``fi``."""
+        out: dict[str, ClassInfo] = {}
+        for node in walk_no_nested(fi.node.body):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                target = self._resolve_scope_name(
+                    fi.module, _dotted(node.value.func)
+                )
+                if isinstance(target, ClassInfo):
+                    out[node.targets[0].id] = target
+        return out
+
+    def resolve_callable(self, fi: FuncInfo | None, module: str,
+                         expr: ast.AST) -> FuncInfo | None:
+        """Resolve a callable *expression* (a Thread target, a submit
+        arg, or a Call's ``func``) to its FuncInfo, or None."""
+        cls = fi.cls if fi is not None else None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            # innermost enclosing function's nested defs first
+            scope_fi = fi
+            while scope_fi is not None:
+                if name in scope_fi.nested:
+                    return scope_fi.nested[name]
+                scope_fi = scope_fi.parent
+            obj = self._resolve_scope_name(module, name)
+            if isinstance(obj, FuncInfo):
+                return obj
+            if isinstance(obj, ClassInfo):
+                return self.lookup_method(obj, "__init__")
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            recv = expr.value
+            if isinstance(recv, ast.Name):
+                if recv.id in ("self", "cls") and cls is not None:
+                    return self.lookup_method(cls, attr)
+                obj = self._resolve_scope_name(module, recv.id)
+                if isinstance(obj, ClassInfo):
+                    return self.lookup_method(obj, attr)
+                if isinstance(obj, str):  # imported module
+                    sub = self.scope.get(obj, {}).get(attr)
+                    if isinstance(sub, FuncInfo):
+                        return sub
+                    if isinstance(sub, ClassInfo):
+                        return self.lookup_method(sub, "__init__")
+                    return None
+                if fi is not None:
+                    lt = self._local_var_types(fi).get(recv.id)
+                    if lt is not None:
+                        return self.lookup_method(lt, attr)
+                return None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id in ("self", "cls")
+                and cls is not None
+            ):
+                # self._attr.m() through the inferred attribute type
+                at = self.attr_type(cls, recv.attr)
+                if at is not None:
+                    return self.lookup_method(at, attr)
+                return None
+            d = _dotted(recv)
+            if d is not None:
+                # pkg.mod.f() through the import map
+                head = d.split(".")[0]
+                imp = self.imports.get(module, {}).get(head)
+                if imp is not None:
+                    full = d.replace(head, imp, 1) + "." + attr
+                    obj = self._resolve_imported(full)
+                    if isinstance(obj, FuncInfo):
+                        return obj
+                    if isinstance(obj, ClassInfo):
+                        return self.lookup_method(obj, "__init__")
+            return None
+        return None
+
+    # -- edges ---------------------------------------------------------------
+
+    def callees(self, fi: FuncInfo) -> list:
+        """[(ast.Call, FuncInfo)] for every resolvable call lexically in
+        ``fi`` (nested defs excluded — they run in their own context)."""
+        cached = self._callee_cache.get(fi.qualname)
+        if cached is not None:
+            return cached
+        out = []
+        for node in walk_no_nested(fi.node.body):
+            if isinstance(node, ast.Call):
+                target = self.resolve_callable(fi, fi.module, node.func)
+                if target is not None:
+                    out.append((node, target))
+        out.sort(key=lambda t: (t[0].lineno, t[0].col_offset, t[1].qualname))
+        self._callee_cache[fi.qualname] = out
+        return out
+
+    def reachable(self, start: FuncInfo) -> dict[str, list]:
+        """{qualname: call-site chain [(path, line), ...]} for every
+        function reachable from ``start`` (BFS; first/shortest chain
+        kept, deterministic)."""
+        seen: dict[str, list] = {start.qualname: []}
+        frontier = [start]
+        while frontier:
+            nxt: list[FuncInfo] = []
+            for fi in frontier:
+                chain = seen[fi.qualname]
+                for call, target in self.callees(fi):
+                    if target.qualname in seen:
+                        continue
+                    seen[target.qualname] = chain + [(fi.path, call.lineno)]
+                    nxt.append(target)
+            frontier = nxt
+        return seen
+
+    def enclosing_functions(self, module: str):
+        """Every FuncInfo of ``module`` (methods, functions, nested)."""
+        return sorted(
+            (f for f in self.functions.values() if f.module == module),
+            key=lambda f: (f.lineno, f.qualname),
+        )

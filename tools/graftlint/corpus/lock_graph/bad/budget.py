@@ -1,0 +1,26 @@
+"""Bad corpus: the admit path holds the budget lock while calling into
+the store (budget-lock -> store-lock), while store.sync holds the store
+lock while calling back into budget.account (store-lock -> budget-lock).
+Opposite orders: a deadlock the first time two threads interleave."""
+
+import threading
+
+import store
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.store = store.Store()
+
+    def admit(self, key, nbytes):
+        with self._lock:
+            self._entries[key] = nbytes
+            # BUG: callback invoked while the budget lock is held; the
+            # callee takes Store._lock -> edge Budget._lock -> Store._lock
+            self.store.drop(key)
+
+    def account(self, key, nbytes):
+        with self._lock:
+            self._entries[key] = nbytes

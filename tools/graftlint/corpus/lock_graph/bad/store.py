@@ -1,0 +1,24 @@
+"""Bad corpus, other half: Store.sync holds its own lock while calling
+Budget.account — the reverse of budget.Budget.admit's order."""
+
+import threading
+
+import budget as budget_mod
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._buf = None
+
+    def drop(self, key):
+        with self._lock:
+            self._buf = None
+
+    def sync(self, key, arr):
+        b = budget_mod.Budget()
+        with self._lock:
+            self._buf = arr
+            # BUG: edge Store._lock -> Budget._lock; together with
+            # Budget.admit this closes the cycle
+            b.account(key, len(arr))

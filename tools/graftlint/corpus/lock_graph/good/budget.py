@@ -1,0 +1,26 @@
+"""Good corpus twin: victims are collected under the budget lock and
+their callbacks run AFTER it is released, so budget-lock -> store-lock
+never forms; the only order is store -> budget (consistent)."""
+
+import threading
+
+import store
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.store = store.Store()
+
+    def admit(self, key, nbytes):
+        victims = []
+        with self._lock:
+            self._entries[key] = nbytes
+            victims.append(key)
+        for v in victims:  # callbacks outside the critical section
+            self.store.drop(v)
+
+    def account(self, key, nbytes):
+        with self._lock:
+            self._entries[key] = nbytes

@@ -1,0 +1,23 @@
+"""Good corpus twin: Store.sync still holds its lock across
+Budget.account — one consistent global order (Store._lock before
+Budget._lock) has no cycle."""
+
+import threading
+
+import budget as budget_mod
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._buf = None
+
+    def drop(self, key):
+        with self._lock:
+            self._buf = None
+
+    def sync(self, key, arr):
+        b = budget_mod.Budget()
+        with self._lock:
+            self._buf = arr
+            b.account(key, len(arr))

@@ -1,0 +1,15 @@
+"""Bad corpus: a deadline-style module-level ContextVar and its reader
+(the context root the pass discovers automatically)."""
+
+import contextvars
+
+_budget = contextvars.ContextVar("budget", default=None)
+
+
+def remaining():
+    return _budget.get()
+
+
+def check():
+    if remaining() == 0:
+        raise TimeoutError("deadline exceeded")

@@ -1,0 +1,24 @@
+"""Bad corpus: two thread boundaries whose targets transitively read the
+contextvar, neither snapshotting context — both lose the deadline."""
+
+import threading
+
+import ctxmod
+
+
+def work(item):
+    ctxmod.check()
+    return item
+
+
+def fan_out(pool, items):
+    for item in items:
+        # BUG: pool worker runs without the submitter's context
+        pool.submit(work, item)
+
+
+def spawn_worker(item):
+    # BUG: fresh thread starts with an empty context; the deadline dies
+    t = threading.Thread(target=work, args=(item,), daemon=True)
+    t.start()
+    return t

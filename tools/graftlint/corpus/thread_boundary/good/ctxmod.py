@@ -1,0 +1,15 @@
+"""Good corpus twin: the same context root — reading it is fine; only
+un-snapshotted thread boundaries are findings."""
+
+import contextvars
+
+_budget = contextvars.ContextVar("budget", default=None)
+
+
+def remaining():
+    return _budget.get()
+
+
+def check():
+    if remaining() == 0:
+        raise TimeoutError("deadline exceeded")

@@ -1,0 +1,34 @@
+"""Good corpus twin: every boundary snapshots context — the explicit
+copy_context idiom and one deliberately context-free service thread with
+a reasoned suppression."""
+
+import contextvars
+import threading
+
+import ctxmod
+
+
+def work(item):
+    ctxmod.check()
+    return item
+
+
+def fan_out(pool, items):
+    ctx = contextvars.copy_context()
+    for item in items:
+        pool.submit(ctx.run, work, item)
+
+
+def spawn_worker(item):
+    ctx = contextvars.copy_context()
+    t = threading.Thread(target=ctx.run, args=(work, item), daemon=True)
+    t.start()
+    return t
+
+
+def boot_monitor():
+    # service thread started at boot: there is no request context to
+    # capture, and the loop derives its own budgets
+    t = threading.Thread(target=work, args=(None,), daemon=True)  # graftlint: disable=thread-boundary -- boot-time service thread; no ambient request context exists to snapshot
+    t.start()
+    return t

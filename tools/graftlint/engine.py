@@ -12,6 +12,15 @@ Project-wide passes (cross-file consistency) instead expose:
     PROJECT = True
     def check_project(files: dict[str, tuple[ast.AST, list[str]]]) -> list[Finding]
 
+Whole-program passes that need interprocedural reasoning additionally set
+``USES_CALLGRAPH = True`` and receive a shared
+:class:`tools.graftlint.callgraph.CallGraph` (built once per run) as a
+second argument:
+
+    PROJECT = True
+    USES_CALLGRAPH = True
+    def check_project(files, graph) -> list[Finding]
+
 Suppression comments (reason MANDATORY after ``--``)::
 
     # graftlint: disable=<pass>[,<pass>] -- <reason>        (this line only)
@@ -160,17 +169,72 @@ def load_passes():
     return ALL_PASSES
 
 
-def run(roots: list[str], passes=None) -> list[Finding]:
-    """Lint ``roots``; returns every finding, suppressed ones marked."""
+def _check_one_file(path: str, file_passes) -> tuple[list[Finding], dict]:
+    """Per-file passes over one file; (findings, {pass_id: seconds}).
+    Module-level so ``--jobs`` worker processes can pickle the call."""
+    import time as _time
+
+    findings: list[Finding] = []
+    timings: dict[str, float] = {}
+    tree, lines, err = parse_file(path)
+    if err is not None:
+        return findings, timings  # the parent reports parse errors
+    rel = path.replace(os.sep, "/")
+    for p in file_passes:
+        if p.applies(rel):
+            t0 = _time.perf_counter()
+            findings.extend(p.check(path, tree, lines))
+            timings[p.PASS_ID] = (
+                timings.get(p.PASS_ID, 0.0) + _time.perf_counter() - t0
+            )
+    return findings, timings
+
+
+def _worker(path: str) -> tuple[list[Finding], dict]:
+    passes = [p for p in load_passes() if not getattr(p, "PROJECT", False)]
+    return _check_one_file(path, passes)
+
+
+def run(
+    roots: list[str],
+    passes=None,
+    jobs: int = 1,
+    timings: dict | None = None,
+) -> list[Finding]:
+    """Lint ``roots``; returns every finding, suppressed ones marked.
+
+    ``jobs > 1`` fans the per-file passes out over a process pool;
+    finding order is identical to the serial run (results are folded in
+    input-file order, and each file's findings keep pass order).
+    Parsing, suppression collection, and the project-wide passes stay in
+    the parent: they need every file at once (the call graph is global).
+    ``timings``, when a dict, is filled with {pass_id: seconds}.
+    """
+    import time as _time
+
     if passes is None:
         passes = load_passes()
     file_passes = [p for p in passes if not getattr(p, "PROJECT", False)]
     project_passes = [p for p in passes if getattr(p, "PROJECT", False)]
+    if timings is None:
+        timings = {}
 
     findings: list[Finding] = []
     parsed: dict[str, tuple[ast.AST, list[str]]] = {}
     supp: dict[str, Suppressions] = {}
-    for path in walk_files(roots):
+    paths = walk_files(roots)
+
+    per_file: dict[str, list[Finding]] = {}
+    if jobs > 1 and len(paths) > 1:
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as pool:
+            for path, (fs, ts) in zip(paths, pool.map(_worker, paths)):
+                per_file[path] = fs
+                for pid, sec in ts.items():
+                    timings[pid] = timings.get(pid, 0.0) + sec
+
+    for path in paths:
         tree, lines, err = parse_file(path)
         supp[path] = Suppressions(path, lines)
         findings.extend(supp[path].errors)
@@ -178,12 +242,30 @@ def run(roots: list[str], passes=None) -> list[Finding]:
             findings.append(err)
             continue
         parsed[path] = (tree, lines)
-        rel = path.replace(os.sep, "/")
-        for p in file_passes:
-            if p.applies(rel):
-                findings.extend(p.check(path, tree, lines))
+        if path in per_file:
+            findings.extend(per_file[path])
+        else:
+            fs, ts = _check_one_file(path, file_passes)
+            findings.extend(fs)
+            for pid, sec in ts.items():
+                timings[pid] = timings.get(pid, 0.0) + sec
+
+    graph = None
+    if any(getattr(p, "USES_CALLGRAPH", False) for p in project_passes):
+        from tools.graftlint.callgraph import CallGraph
+
+        t0 = _time.perf_counter()
+        graph = CallGraph(parsed)
+        timings["callgraph-build"] = _time.perf_counter() - t0
     for p in project_passes:
-        findings.extend(p.check_project(parsed))
+        t0 = _time.perf_counter()
+        if getattr(p, "USES_CALLGRAPH", False):
+            findings.extend(p.check_project(parsed, graph))
+        else:
+            findings.extend(p.check_project(parsed))
+        timings[p.PASS_ID] = (
+            timings.get(p.PASS_ID, 0.0) + _time.perf_counter() - t0
+        )
 
     for f in findings:
         if f.pass_id == "bad-suppression":

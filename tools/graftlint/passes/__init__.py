@@ -9,10 +9,12 @@ from tools.graftlint.passes import (
     exception_hygiene,
     launch_discipline,
     lock_discipline,
+    lock_graph,
     log_discipline,
     queue_discipline,
     residency_discipline,
     span_discipline,
+    thread_boundary,
     timeout_discipline,
     tpu_purity,
 )
@@ -31,6 +33,8 @@ ALL_PASSES = [
     residency_discipline,
     cache_discipline,
     launch_discipline,
+    lock_graph,
+    thread_boundary,
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

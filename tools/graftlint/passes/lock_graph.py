@@ -1,0 +1,433 @@
+"""lock-graph: whole-program lock acquisition-order analysis.
+
+The serving plane runs many long-lived threads (batcher dispatcher,
+ingest uploader, flight recorder, resize coordinator, membership
+monitor, importpool workers, prefetcher) against shared state guarded by
+per-class ``threading.Lock``/``RLock`` fields.  Two threads that acquire
+the same pair of locks in opposite orders deadlock the first time their
+schedules interleave — a bug no single-file lint can see, because the
+two halves of the inversion live in different modules (the classic
+example this pass exists for: ``core/membudget.py`` evict callbacks vs
+``core/fragment.py`` device sync).
+
+Eraser-style lockset analysis, statically:
+
+* **lock identity** — a lock is ``(class, attr)`` for ``self._x =
+  threading.Lock()`` fields (every instance of the class maps to one
+  node: order must be consistent *per class*, which is also what the
+  runtime witness in ``pilosa_tpu/testing/lockwitness.py`` keys on) or
+  ``(module, name)`` for module-level locks.  ``threading.Condition(L)``
+  aliases to its underlying lock.
+* **held sets** — ``with self._lock:`` opens a region; direct nested
+  acquisitions and *interprocedural* acquisitions (calls resolved
+  through tools/graftlint/callgraph.py, transitively) add edges
+  ``held → acquired`` to the global acquisition-order graph.
+* **report** — every cycle in the graph is a potential deadlock; the
+  finding prints one witness path per edge as ``file:line → file:line``
+  (the with-statement that holds, the call chain, the acquisition).
+
+Deliberate under-approximation (documented so suppressions can cite it):
+explicit ``.acquire()`` calls are ignored (the tree's only ones are
+non-blocking try-acquires, which cannot wait and so cannot deadlock),
+self-edges are skipped (RLock re-entrancy and the shared class-level
+identity make them overwhelmingly false), and unresolvable dynamic calls
+truncate the walk.  The runtime witness covers the remainder: an
+inversion the static graph misses shows up as a runtime-only edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftlint.callgraph import CallGraph, FuncInfo, _dotted
+from tools.graftlint.engine import Finding
+
+PASS_ID = "lock-graph"
+DESCRIPTION = "whole-program lock acquisition-order cycles (potential deadlock)"
+PROJECT = True
+USES_CALLGRAPH = True
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+}
+_COND_CTORS = {"threading.Condition", "Condition"}
+
+
+def applies(path: str) -> bool:  # unused for project passes; kept uniform
+    return False
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        r = os.path.relpath(path, root)
+    except ValueError:  # pragma: no cover - windows drive mismatch
+        return path
+    return r.replace(os.sep, "/")
+
+
+class _Analysis:
+    def __init__(self, files: dict, graph: CallGraph):
+        self.graph = graph
+        self.root = graph.root
+        # lock id -> human label
+        self.locks: dict[str, str] = {}
+        # (module, class name or None, attr/name) -> lock id
+        self.class_locks: dict[tuple[str, str], str] = {}  # (cls qual, attr)
+        self.module_locks: dict[tuple[str, str], str] = {}  # (module, name)
+        self._collect_locks(files)
+        # per-function facts
+        self.direct: dict[str, list] = {}  # qual -> [(lock, site, held)]
+        self.calls: dict[str, list] = {}  # qual -> [(callee qual, site, held)]
+        for fi in sorted(graph.functions.values(), key=lambda f: f.qualname):
+            self._scan_function(fi)
+        self.summary = self._summaries()
+
+    # -- lock discovery ------------------------------------------------------
+
+    def _collect_locks(self, files: dict) -> None:
+        g = self.graph
+        conditions: list[tuple[str, str, ast.Call]] = []
+        for ci in sorted(g.classes.values(), key=lambda c: c.qualname):
+            for attr, (call, _ln) in sorted(ci.attr_assigns.items()):
+                d = _dotted(call.func) or ""
+                if d in _LOCK_CTORS:
+                    lid = f"{ci.qualname}.{attr}"
+                    self.class_locks[(ci.qualname, attr)] = lid
+                    self.locks[lid] = f"{ci.name}.{attr}"
+                elif d in _COND_CTORS:
+                    conditions.append((ci.qualname, attr, call))
+        for module in sorted(g.module_tree):
+            tree = g.module_tree[module]
+            for node in tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    d = _dotted(node.value.func) or ""
+                    name = node.targets[0].id
+                    if d in _LOCK_CTORS:
+                        lid = f"{module}:{name}"
+                        self.module_locks[(module, name)] = lid
+                        self.locks[lid] = f"{module}.{name}"
+        # Condition(self._x) shares its underlying lock; Condition()
+        # owns a fresh one
+        for cls_qual, attr, call in conditions:
+            lid = None
+            if call.args:
+                a = call.args[0]
+                if (
+                    isinstance(a, ast.Attribute)
+                    and isinstance(a.value, ast.Name)
+                    and a.value.id == "self"
+                ):
+                    lid = self.class_locks.get((cls_qual, a.attr))
+            if lid is None:
+                lid = f"{cls_qual}.{attr}"
+                self.locks[lid] = f"{cls_qual.split(':')[-1]}.{attr}"
+            self.class_locks[(cls_qual, attr)] = lid
+
+    # -- acquisition resolution ----------------------------------------------
+
+    def _lock_of_expr(self, fi: FuncInfo, expr: ast.AST) -> str | None:
+        """``with <expr>:`` → lock id, when expr names a known lock."""
+        g = self.graph
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get((fi.module, expr.id))
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            if isinstance(recv, ast.Name):
+                if recv.id in ("self", "cls") and fi.cls is not None:
+                    for c in g.mro(fi.cls):
+                        lid = self.class_locks.get((c.qualname, expr.attr))
+                        if lid is not None:
+                            return lid
+                    return None
+                # module-level lock through an import: mod._lock
+                imp = g.imports.get(fi.module, {}).get(recv.id)
+                if isinstance(imp, str) and imp in g.module_path:
+                    return self.module_locks.get((imp, expr.attr))
+                # local var of inferred project type: v._lock
+                lt = g._local_var_types(fi).get(recv.id)
+                if lt is not None:
+                    for c in g.mro(lt):
+                        lid = self.class_locks.get((c.qualname, expr.attr))
+                        if lid is not None:
+                            return lid
+                return None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id in ("self", "cls")
+                and fi.cls is not None
+            ):
+                # with self._attr._lock: through the inferred attr type
+                at = g.attr_type(fi.cls, recv.attr)
+                if at is not None:
+                    for c in g.mro(at):
+                        lid = self.class_locks.get((c.qualname, expr.attr))
+                        if lid is not None:
+                            return lid
+        return None
+
+    def _scan_function(self, fi: FuncInfo) -> None:
+        direct: list = []
+        calls: list = []
+        root = self.root
+
+        def visit(stmts, held):
+            for node in stmts:
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                if isinstance(node, ast.With):
+                    inner = held
+                    for item in node.items:
+                        expr = item.context_expr
+                        lid = self._lock_of_expr(fi, expr)
+                        if lid is not None:
+                            site = (_rel(fi.path, root), expr.lineno)
+                            direct.append((lid, site, inner))
+                            inner = inner + ((lid, site),)
+                        else:
+                            self._scan_expr(fi, expr, inner, calls)
+                    visit(node.body, inner)
+                    continue
+                # non-with statement: scan expressions for calls, then
+                # recurse into compound bodies with the same held set
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(node, field, None)
+                    if sub:
+                        visit(sub, held)
+                for h in getattr(node, "handlers", []) or []:
+                    visit(h.body, held)
+                self._scan_stmt_exprs(fi, node, held, calls)
+
+        visit(fi.node.body, ())
+        if direct:
+            self.direct[fi.qualname] = direct
+        if calls:
+            self.calls[fi.qualname] = calls
+
+    def _scan_stmt_exprs(self, fi, node, held, calls) -> None:
+        """Record resolvable calls in the *expression* parts of one
+        statement (not its nested statement bodies)."""
+        for field, value in ast.iter_fields(node):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            vals = value if isinstance(value, list) else [value]
+            for v in vals:
+                if isinstance(v, ast.AST):
+                    self._scan_expr(fi, v, held, calls)
+
+    def _scan_expr(self, fi, expr, held, calls) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # closures run later, outside this held set
+            if isinstance(node, ast.Call):
+                target = self.graph.resolve_callable(fi, fi.module, node.func)
+                if target is not None:
+                    site = (_rel(fi.path, self.root), node.lineno)
+                    calls.append((target.qualname, site, held))
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- transitive acquisition summaries ------------------------------------
+
+    def _summaries(self) -> dict:
+        """qual -> {lock: chain [(path,line),...]} of every acquisition
+        reachable from the function with an EMPTY entry held set."""
+        summary: dict[str, dict[str, tuple]] = {}
+        for qual in self.graph.functions:
+            summary[qual] = {}
+        for qual, acqs in self.direct.items():
+            for lid, site, _held in acqs:
+                cur = summary[qual].get(lid)
+                if cur is None or (site,) < cur:
+                    summary[qual][lid] = (site,)
+        # fixpoint: pull callee summaries through call sites
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for qual in sorted(self.calls):
+                mine = summary[qual]
+                for callee, site, _held in self.calls[qual]:
+                    for lid, chain in summary.get(callee, {}).items():
+                        cand = (site,) + chain
+                        cur = mine.get(lid)
+                        if cur is None or len(cand) < len(cur) or (
+                            len(cand) == len(cur) and cand < cur
+                        ):
+                            mine[lid] = cand
+                            changed = True
+        return summary
+
+    # -- edges + cycles ------------------------------------------------------
+
+    def edges(self) -> dict:
+        """{(held, acquired): witness} where witness = (held-site,
+        chain-to-acquisition)."""
+        out: dict[tuple, tuple] = {}
+
+        def add(a, b, witness):
+            if a == b:
+                return
+            cur = out.get((a, b))
+            if cur is None or (len(witness[1]), witness) < (len(cur[1]), cur):
+                out[(a, b)] = witness
+
+        for qual in sorted(self.direct):
+            for lid, site, held in self.direct[qual]:
+                for h, hsite in held:
+                    add(h, lid, (hsite, (site,)))
+        for qual in sorted(self.calls):
+            for callee, site, held in self.calls[qual]:
+                if not held:
+                    continue
+                for lid, chain in self.summary.get(callee, {}).items():
+                    for h, hsite in held:
+                        add(h, lid, (hsite, (site,) + chain))
+        return out
+
+
+def _fmt_chain(witness) -> str:
+    hsite, chain = witness
+    steps = [f"{p}:{ln}" for p, ln in (hsite,) + tuple(chain)]
+    return " → ".join(steps)
+
+
+def _cycles(edges: dict) -> list[list[str]]:
+    """Deterministic minimal cycles: for every SCC of size >= 2, the
+    shortest cycle through its lexicographically-smallest lock."""
+    adj: dict[str, list[str]] = {}
+    nodes: set[str] = set()
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        nodes.add(a)
+        nodes.add(b)
+    for a in adj:
+        adj[a].sort()
+
+    # iterative Tarjan SCC
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    for start in sorted(nodes):
+        if start in index:
+            continue
+        work = [(start, iter(adj.get(start, [])))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on.add(start)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, []))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    out: list[list[str]] = []
+    for comp in sorted(sccs):
+        comp_set = set(comp)
+        start = comp[0]
+        # BFS back to start within the SCC
+        prev: dict[str, str] = {}
+        frontier = [start]
+        found = None
+        seen = set()
+        while frontier and found is None:
+            nxt = []
+            for v in frontier:
+                for w in adj.get(v, []):
+                    if w == start:
+                        found = v
+                        break
+                    if w in comp_set and w not in seen:
+                        seen.add(w)
+                        prev[w] = v
+                        nxt.append(w)
+                if found is not None:
+                    break
+            frontier = nxt
+        if found is None:  # pragma: no cover - SCC guarantees a cycle
+            continue
+        path = [found]
+        while path[-1] != start:
+            path.append(prev[path[-1]])
+        path.reverse()  # start ... found, then found->start closes it
+        out.append(path)
+    return out
+
+
+def check_project(files: dict, graph: CallGraph) -> list[Finding]:
+    an = _Analysis(files, graph)
+    edges = an.edges()
+    findings: list[Finding] = []
+    for cycle in _cycles(edges):
+        hops = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            w = edges[(a, b)]
+            hops.append(
+                f"{an.locks.get(a, a)} → {an.locks.get(b, b)}"
+                f" [{_fmt_chain(w)}]"
+            )
+        first = edges[(cycle[0], cycle[1 % len(cycle)])]
+        anchor_path, anchor_line = first[0]
+        names = " → ".join(
+            an.locks.get(x, x) for x in cycle + [cycle[0]]
+        )
+        findings.append(
+            Finding(
+                _abspath(files, anchor_path), anchor_line, 0, PASS_ID,
+                f"lock-order cycle (potential deadlock): {names}; "
+                + "; ".join(hops),
+            )
+        )
+    return findings
+
+
+def _abspath(files: dict, rel: str) -> str:
+    """Map a root-relative witness path back to the engine's path key so
+    suppression comments in that file apply."""
+    for path in files:
+        if path.replace(os.sep, "/").endswith(rel):
+            return path
+    return rel

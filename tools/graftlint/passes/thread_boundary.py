@@ -1,0 +1,185 @@
+"""thread-boundary: context must not silently die at thread creation.
+
+The deadline budget (pilosa_tpu/deadline.py), the query profile
+(obs/qprofile.py), the trace span (obs/tracing.py), and the device-cost
+tenant binding (obs/devledger.py) all live in ``contextvars`` — they
+follow a request through same-thread calls for free and evaporate at
+every ``threading.Thread(target=...)`` / ``pool.submit(...)`` boundary,
+because a new thread starts with an empty context.  The failure mode is
+silent: the spawned work runs, just without its deadline (unbounded
+hop), without its tenant (cost lands on the default principal), and
+without its profile (the span tree loses a subtree).
+
+The pass is whole-program: the spawn target is resolved through the
+call graph and its transitive closure is checked for *context roots* —
+functions that read a module-level ``contextvars.ContextVar``.  Roots
+are discovered, not hardcoded: any module in the linted tree that
+assigns a ContextVar at top level contributes every function that
+references that variable, so a new contextvar-carrying subsystem is
+covered the day it lands.
+
+A flagged spawn is fixed by snapshotting context at the boundary —
+``pilosa_tpu/threadctx.py`` (the blessed helper) or a literal
+``contextvars.copy_context()`` in the spawning function — or suppressed
+with a reason when the thread is *deliberately* context-free (service
+threads started at boot: there is no request context to capture, and
+capturing the constructor's would pin garbage).
+
+Test files are exempt: a test thread's missing context is the test's
+own business, and the runtime lockwitness already covers tests
+dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftlint.callgraph import CallGraph, _dotted, walk_no_nested
+from tools.graftlint.engine import Finding
+
+PASS_ID = "thread-boundary"
+DESCRIPTION = "Thread/submit targets that lose deadline/tenant/profile context"
+PROJECT = True
+USES_CALLGRAPH = True
+
+_CTXVAR_CTORS = {"contextvars.ContextVar", "ContextVar"}
+_PROPAGATION_MARKS = {"copy_context", "wrap", "spawn"}
+
+
+def applies(path: str) -> bool:  # unused for project passes; kept uniform
+    return False
+
+
+def _is_test_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return (
+        "/tests/" in p
+        or p.startswith("tests/")
+        or os.path.basename(p).startswith("test_")
+    )
+
+
+def _context_roots(graph: CallGraph) -> dict[str, str]:
+    """{func qualname: contextvar name} for every function that reads a
+    module-level ContextVar defined in its own module."""
+    roots: dict[str, str] = {}
+    for module in sorted(graph.module_tree):
+        tree = graph.module_tree[module]
+        ctxvars: set[str] = set()
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if (
+                isinstance(value, ast.Call)
+                and (_dotted(value.func) or "") in _CTXVAR_CTORS
+            ):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        ctxvars.add(t.id)
+        if not ctxvars:
+            continue
+        for fi in graph.enclosing_functions(module):
+            for node in walk_no_nested(fi.node.body):
+                if isinstance(node, ast.Name) and node.id in ctxvars:
+                    roots.setdefault(fi.qualname, node.id)
+                    break
+    return roots
+
+
+def _spawn_sites(graph: CallGraph):
+    """Yield (FuncInfo|None, module, path, call, target_expr, kind) for
+    every Thread(target=...) construction and pool-style .submit(fn)."""
+    for module in sorted(graph.module_tree):
+        path = graph.module_path[module]
+        funcs = graph.enclosing_functions(module)
+        scopes = [(fi, fi.node.body) for fi in funcs]
+        scopes.append((None, graph.module_tree[module].body))
+        for fi, body in scopes:
+            for node in walk_no_nested(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                if d == "threading.Thread" or d == "Thread":
+                    target = None
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                    if target is not None:
+                        yield fi, module, path, node, target, "Thread"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"
+                    and node.args
+                ):
+                    yield fi, module, path, node, node.args[0], "submit"
+
+
+def _propagates(graph: CallGraph, fi, module: str) -> bool:
+    """True when the spawning function (or module top level) shows
+    snapshot evidence: a copy_context() call or the threadctx helper."""
+    body = fi.node.body if fi is not None else graph.module_tree[module].body
+    for node in walk_no_nested(body):
+        if isinstance(node, ast.Attribute) and node.attr in _PROPAGATION_MARKS:
+            if node.attr in ("wrap", "spawn"):
+                # only the threadctx module's wrap/spawn count
+                base = node.value
+                if isinstance(base, ast.Name):
+                    imp = graph.imports.get(module, {}).get(base.id, "")
+                    if not imp.endswith("threadctx"):
+                        continue
+            return True
+        if isinstance(node, ast.Name) and node.id in _PROPAGATION_MARKS:
+            if node.id in ("wrap", "spawn"):
+                imp = graph.imports.get(module, {}).get(node.id, "")
+                if not imp.startswith("pilosa_tpu.threadctx"):
+                    continue
+            return True
+    return False
+
+
+def check_project(files: dict, graph: CallGraph) -> list[Finding]:
+    roots = _context_roots(graph)
+    if not roots:
+        return []
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for fi, module, path, call, target_expr, kind in _spawn_sites(graph):
+        if _is_test_path(path):
+            continue
+        target = graph.resolve_callable(fi, module, target_expr)
+        if target is None:
+            continue
+        reach = graph.reachable(target)
+        hits = sorted(q for q in reach if q in roots)
+        if not hits:
+            continue
+        if _propagates(graph, fi, module):
+            continue
+        key = (path, call.lineno, target.qualname)
+        if key in seen:
+            continue
+        seen.add(key)
+        hit = hits[0]
+        chain = reach[hit]
+        via = " → ".join(
+            [f"{target.qualname}"]
+            + [f"{os.path.relpath(p, graph.root)}:{ln}" for p, ln in chain]
+            + [hit]
+        )
+        findings.append(
+            Finding(
+                path, call.lineno, call.col_offset, PASS_ID,
+                f"{kind} target {target.qualname!r} transitively reads "
+                f"contextvar state ({hit} reads {roots[hit]!r}; via {via}) "
+                "but the spawn never snapshots context: use "
+                "threadctx.spawn/wrap or contextvars.copy_context(), or "
+                "suppress with the reason the thread is context-free",
+            )
+        )
+    return findings

@@ -1,0 +1,214 @@
+"""CI smoke check for the concurrency correctness suite
+(docs/robustness.md "Concurrency discipline").
+
+Boots one real NodeServer with the runtime lockdep witness installed in
+``raise`` mode — every project lock allocation is wrapped before server
+modules load — then drives a concurrent mixed read/ingest burst over
+actual HTTP so handler threads, the batcher dispatcher, the ingest
+uploader, and the residency manager all interleave. Asserts:
+
+* the burst completes with zero errors and **zero lock-order
+  inversions** recorded (an inversion would have raised at its
+  acquisition site inside a server thread and failed the request);
+* the witness actually saw the serving plane (acquisitions and order
+  edges were recorded, not a silent no-op);
+* **static↔runtime cross-check**: runtime order edges are mapped onto
+  the static lock-graph identities through their shared allocation
+  sites, and the merged static+runtime acquisition-order graph is still
+  acyclic — a runtime edge that reverses a static edge (or vice versa)
+  is a deadlock neither side could prove alone.
+
+Exit status 0 on success; any assertion/exception fails the CI step.
+Run as ``python -m tools.smoke_lockwitness``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import urllib.request
+
+N_FIELDS = 6
+WRITER_THREADS = 4
+READER_THREADS = 6
+OPS_PER_THREAD = 30
+
+
+def _get(uri: str) -> bytes:
+    return urllib.request.urlopen(uri, timeout=10).read()
+
+
+def _post(uri: str, body: bytes, ctype: str = "text/plain") -> bytes:
+    req = urllib.request.Request(
+        uri, data=body, headers={"Content-Type": ctype}, method="POST"
+    )
+    return urllib.request.urlopen(req, timeout=10).read()
+
+
+def _static_lock_graph():
+    """(site -> static lock id, static edge set) from the lock-graph
+    pass, over the same tree the witness scopes to."""
+    import os
+
+    from tools.graftlint import engine
+    from tools.graftlint.callgraph import CallGraph, _dotted
+    from tools.graftlint.passes import lock_graph
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = [os.path.join(repo, d) for d in ("pilosa_tpu", "tools")]
+    parsed = {}
+    for path in engine.walk_files(roots):
+        tree, lines, err = engine.parse_file(path)
+        if err is None:
+            parsed[path] = (tree, lines)
+    graph = CallGraph(parsed, root=repo)
+    an = lock_graph._Analysis(parsed, graph)
+
+    sites: dict[str, str] = {}
+    for ci in graph.classes.values():
+        for attr, (call, _ln) in ci.attr_assigns.items():
+            lid = an.class_locks.get((ci.qualname, attr))
+            if lid is not None:
+                rel = lock_graph._rel(ci.path, repo)
+                sites[f"{rel}:{call.lineno}"] = lid
+    import ast
+
+    for module, tree in graph.module_tree.items():
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                lid = an.module_locks.get((module, node.targets[0].id))
+                if lid is not None:
+                    rel = lock_graph._rel(graph.module_path[module], repo)
+                    sites[f"{rel}:{node.value.lineno}"] = lid
+    return sites, set(an.edges()), lock_graph._cycles
+
+
+def main() -> int:
+    # install BEFORE server modules import, so their module-level locks
+    # are allocated through the patched factories
+    from pilosa_tpu.testing import lockwitness
+
+    lockwitness.install(mode="raise")
+    lockwitness.reset()
+
+    from pilosa_tpu.server.node import NodeServer
+
+    node = NodeServer(port=0, batch_window=0.003, batch_max_size=32)
+    node.start()
+    try:
+        base = node.uri
+        _post(f"{base}/index/lw", b"{}", "application/json")
+        for fi in range(N_FIELDS):
+            _post(
+                f"{base}/index/lw/field/f{fi}",
+                b'{"options": {}}',
+                "application/json",
+            )
+        # seed rows so reads have something to intersect
+        seed = "".join(
+            f"Set({col}, f{fi}={row})"
+            for fi in range(N_FIELDS)
+            for row in (1, 2)
+            for col in range(0, 64, 4)
+        )
+        _post(f"{base}/index/lw/query", seed.encode())
+
+        errors: list[BaseException] = []
+
+        def writer(seedn: int) -> None:
+            r = random.Random(seedn)
+            try:
+                for _ in range(OPS_PER_THREAD):
+                    fi = r.randrange(N_FIELDS)
+                    ops = "".join(
+                        f"Set({r.randrange(512)}, f{fi}={r.choice((1, 2))})"
+                        for _ in range(8)
+                    )
+                    resp = json.loads(
+                        _post(f"{base}/index/lw/query", ops.encode())
+                    )
+                    assert "results" in resp, resp
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+
+        def reader(seedn: int) -> None:
+            r = random.Random(seedn)
+            try:
+                for _ in range(OPS_PER_THREAD):
+                    fi = r.randrange(N_FIELDS)
+                    q = r.choice(
+                        (
+                            f"Count(Row(f{fi}=1))",
+                            f"Count(Intersect(Row(f{fi}=1), Row(f{fi}=2)))",
+                            f"TopN(f{fi}, n=2)",
+                        )
+                    )
+                    resp = json.loads(
+                        _post(f"{base}/index/lw/query", q.encode())
+                    )
+                    assert "results" in resp, resp
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(100 + i,), daemon=True)
+            for i in range(WRITER_THREADS)
+        ] + [
+            threading.Thread(target=reader, args=(200 + i,), daemon=True)
+            for i in range(READER_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "burst thread hung"
+        assert not errors, errors[:3]
+        assert node.api.ingest.uploader.flush(10.0), "uploader never idled"
+
+        # zero inversions across the whole burst (raise mode would also
+        # have failed the owning request, but a worker thread may have
+        # swallowed the exception — findings() is the ground truth)
+        assert lockwitness.findings() == [], lockwitness.findings()
+        stats = lockwitness.stats()
+        assert stats["witnessedAcquires"] > 100, stats
+        assert stats["edges"] > 0, stats
+        runtime_edges = lockwitness.order_graph()
+
+        # static <-> runtime cross-check: merge both order graphs over
+        # the shared allocation-site identity; a cycle in the union is a
+        # deadlock neither half could prove alone
+        sites, static_edges, cycles = _static_lock_graph()
+        mapped = 0
+        merged: dict[tuple, tuple] = {
+            e: (("static", 0), ()) for e in static_edges
+        }
+        for (a, b), _w in runtime_edges.items():
+            la, lb = sites.get(a), sites.get(b)
+            if la is None or lb is None or la == lb:
+                continue
+            mapped += 1
+            merged.setdefault((la, lb), (("runtime", 0), ()))
+        cyc = cycles(merged)
+        assert cyc == [], f"static+runtime order graph has cycles: {cyc}"
+
+        print(
+            "smoke_lockwitness OK: "
+            f"acquires={stats['witnessedAcquires']} "
+            f"runtimeEdges={len(runtime_edges)} "
+            f"mappedToStatic={mapped} staticEdges={len(static_edges)} "
+            f"inversions=0"
+        )
+        return 0
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,13 +106,14 @@ def main() -> int:
                 errors.append(e)
 
         threads = [
-            threading.Thread(target=worker, args=(i,))
+            threading.Thread(target=worker, args=(i,), daemon=True)
             for i in range(BURST_THREADS)
         ]
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            t.join(timeout=120)
+            assert not t.is_alive(), "burst thread hung"
         assert not errors, errors[:3]
         assert node.api.ingest.uploader.flush(10.0), "uploader never idled"
 
