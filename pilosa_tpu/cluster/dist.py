@@ -221,6 +221,21 @@ class DistributedExecutor:
             return self.local.rescache_probe(index_name, q, shards)
         return None
 
+    def rescache_degraded(
+        self,
+        index_name: str,
+        q: pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any] | None:
+        """Degraded-tier probe for the QoS governor (server/qos.py).
+        Last-known FULL-result entries only exist on the single-node
+        path (same reasoning as :meth:`rescache_probe`): a multi-node
+        coordinator falls through and the staged tenant's query runs
+        at its reduced weight instead."""
+        if self._single:
+            return self.local.rescache_degraded(index_name, q, shards)
+        return None
+
     def execute_remote(
         self, index_name: str, query: str | pql.Query, shards: list[int] | None
     ) -> list[Any]:

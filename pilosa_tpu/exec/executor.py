@@ -377,6 +377,38 @@ class Executor:
         except Exception:
             return None
 
+    def rescache_degraded(
+        self,
+        index_name: str,
+        q: pql.Query,
+        shards: list[int] | None = None,
+    ) -> list[Any] | None:
+        """Degraded-tier variant of :meth:`rescache_probe`: all-or-
+        nothing over LAST-KNOWN cache entries with the version check
+        waived (rescache.lookup_stale).  The QoS governor routes a
+        pressure-staged tenant's TopN/GroupBy here (server/qos.py);
+        the caller marks the response as degraded.  Returns None when
+        any call has no last-known entry — the query then runs for
+        real at its reduced weight."""
+        idx = self.holder.index(index_name)
+        if idx is None or not q.calls or q.write_calls():
+            return None
+        try:
+            results = []
+            for orig in q.calls:
+                call = orig.clone()
+                self._translate_call(idx, call)
+                res = self.rescache.lookup_stale(idx, call, shards)
+                if res is rescache.MISS:
+                    return None
+                results.append(res)
+            return [
+                self._translate_result(idx, c, r)
+                for c, r in zip(q.calls, results)
+            ]
+        except Exception:
+            return None
+
     def cached_execute_call(
         self, idx: Index, call: Call, shards: list[int] | None
     ) -> Any:

@@ -279,6 +279,7 @@ class ResultCache:
         self.promotions = 0
         self.demotions = 0
         self.maintained_hits = 0
+        self.degraded_hits = 0
         self.stores = 0
         self.evictions = 0
 
@@ -319,6 +320,28 @@ class ResultCache:
         key = self._key(idx, call, shards)
         with qprofile.span("rescache.lookup", call=call.name):
             return self._probe_locked(key, vec, fields, idx.name)
+
+    def lookup_stale(
+        self, idx: Index, call: Call, shards: list[int] | None
+    ) -> Any:
+        """Degraded-tier lookup (server/qos.py pressure stage 2): the
+        LAST-KNOWN result for this exact canonical call, version check
+        waived.  Maintained entries refresh through writes, so the
+        served answer is usually current anyway; a plain entry may be
+        stale — that is the explicit contract of the degraded tier and
+        the response is marked.  Never mutates promotion/invalidation
+        bookkeeping: the degraded path must not distort the cache's
+        steady-state policy.  Returns :data:`MISS` when no entry
+        exists."""
+        key = self._key(idx, call, shards)
+        with qprofile.span("rescache.lookupStale", call=call.name):
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is None:
+                    return MISS
+                self.degraded_hits += 1
+                self.stats.count("rescache_degraded_hits", 1)
+                return copy_result(entry.result)
 
     def probe_raw(self, key: tuple, vector: tuple) -> Any:
         """Distributed partial probe: explicit key + precomputed vector
@@ -511,6 +534,7 @@ class ResultCache:
                 "demotions": self.demotions,
                 "maintainedHits": self.maintained_hits,
                 "maintainedEntries": maintained,
+                "degradedHits": self.degraded_hits,
                 "stores": self.stores,
                 "evictions": self.evictions,
                 "promoteHits": self.promote_hits,

@@ -73,7 +73,14 @@ class StageSpec:
     ``X-Pilosa-Tenant`` header, so the stage's device work lands under
     that principal in the device cost ledger (docs/observability.md);
     the per-stage ``devcosts`` delta and the report's top-level
-    ``devcosts`` block show the attribution."""
+    ``devcosts`` block show the attribution.
+
+    ``tenants`` (``{name: share}``) splits ONE stage's offered load
+    across several tenants by weighted interleave — the QoS overload
+    shape (docs/robustness.md "Governed admission"): a victim at share
+    1 and an aggressor at share 10 ride the same open-loop schedule,
+    and the report's per-tenant breakdown shows who got shed/degraded
+    and who kept their latency.  Mutually exclusive with ``tenant``."""
 
     def __init__(
         self,
@@ -86,6 +93,7 @@ class StageSpec:
         repeat_pool: int | None = None,
         tenant: str | None = None,
         shared_pool: int | None = None,
+        tenants: dict[str, float] | None = None,
     ):
         self.name = name
         self.duration = float(duration)
@@ -98,6 +106,11 @@ class StageSpec:
         self.repeat_pool = int(repeat_pool) if repeat_pool else None
         self.tenant = str(tenant) if tenant else None
         self.shared_pool = int(shared_pool) if shared_pool else None
+        self.tenants = (
+            {str(t): float(s) for t, s in tenants.items()} if tenants else None
+        )
+        if self.tenant and self.tenants:
+            raise ValueError("tenant and tenants are mutually exclusive")
 
     @property
     def op_count(self) -> int:
@@ -114,6 +127,7 @@ class StageSpec:
             "repeatPool": self.repeat_pool,
             "tenant": self.tenant,
             "sharedPool": self.shared_pool,
+            "tenants": self.tenants,
         }
 
 
@@ -164,9 +178,30 @@ class _WorkerResult:
     __slots__ = ("records", "client_errors")
 
     def __init__(self):
-        # (op_class, latency_s, service_s, ok, status)
-        self.records: list[tuple[str, float, float, bool, int]] = []
+        # (op_class, latency_s, service_s, ok, status, tenant)
+        self.records: list[tuple[str, float, float, bool, int, str | None]] = []
         self.client_errors = 0
+
+
+def _tenant_schedule(tenants: dict[str, float], n: int) -> list[str]:
+    """Deterministic weighted interleave of ``n`` slots across tenants.
+
+    Credit-based (smooth weighted round-robin): every slot each tenant
+    earns its share, the richest tenant is picked and pays the total.
+    A {victim: 1, aggressor: 10} split therefore ISSUES interleaved —
+    the victim's requests are spread through the aggressor's flood, not
+    batched before/after it, so the governor sees concurrent pressure."""
+    names = sorted(tenants)
+    total = sum(tenants[t] for t in names) or 1.0
+    credit = dict.fromkeys(names, 0.0)
+    out: list[str] = []
+    for _ in range(n):
+        for t in names:
+            credit[t] += tenants[t]
+        pick = max(names, key=lambda t: (credit[t], t))
+        credit[pick] -= total
+        out.append(pick)
+    return out
 
 
 def _worker(
@@ -179,14 +214,13 @@ def _worker(
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
     headers = {"Content-Type": ""}
-    if tenant:
-        headers["X-Pilosa-Tenant"] = tenant
     try:
         while not stop.is_set():
             item = q.get()
             if item is None:
                 return
-            op, sched = item
+            op, sched, op_tenant = item
+            eff_tenant = op_tenant or tenant
             now = time.monotonic()
             if sched > now:
                 time.sleep(sched - now)
@@ -194,6 +228,10 @@ def _worker(
             status = 0
             try:
                 headers["Content-Type"] = op.ctype
+                if eff_tenant:
+                    headers["X-Pilosa-Tenant"] = eff_tenant
+                else:
+                    headers.pop("X-Pilosa-Tenant", None)
                 conn.request(
                     op.method,
                     op.path,
@@ -211,7 +249,8 @@ def _worker(
             done = time.monotonic()
             ok = 200 <= status < 400
             out.records.append(
-                (op.op_class, done - sched, done - t_start, ok, status)
+                (op.op_class, done - sched, done - t_start, ok, status,
+                 eff_tenant)
             )
     finally:
         conn.close()
@@ -340,6 +379,35 @@ def _devcost_delta(before: dict | None, after: dict | None) -> dict | None:
     return {k: round(after[k] - before[k], 3) for k in before}
 
 
+def _qos_counters(base: str) -> dict | None:
+    """Monotonic per-tenant QoS governor counters from /debug/qos, for
+    per-stage delta arithmetic (None when the node predates the
+    governor or it is disabled)."""
+    snap = _fetch_json(base, "/debug/qos")
+    if not snap or not snap.get("enabled"):
+        return None
+    return {
+        t: {
+            "admitted": st.get("admitted", 0),
+            "served": st.get("served", 0),
+            "shed": st.get("shed", 0),
+            "degraded": st.get("degraded", 0),
+            "debtMs": st.get("debtMs", 0.0),
+        }
+        for t, st in (snap.get("tenants") or {}).items()
+    }
+
+
+def _qos_delta(before: dict | None, after: dict | None) -> dict | None:
+    if before is None or after is None:
+        return None
+    out = {}
+    for t, av in after.items():
+        bv = before.get(t) or {}
+        out[t] = {k: round(av[k] - bv.get(k, 0), 3) for k in av}
+    return out
+
+
 def _fetch_text(base: str, path: str) -> str:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
@@ -425,6 +493,7 @@ class LoadHarness:
             rc_before = _rescache_counters(self.uris[0])
             pl_before = _planner_counters(self.uris[0])
             dc_before = _devcost_counters(self.uris[0])
+            qo_before = _qos_counters(self.uris[0])
             prev_cap: tuple | None = None
             if stage.device_budget is not None:
                 from pilosa_tpu.core import membudget
@@ -466,10 +535,19 @@ class LoadHarness:
                     daemon=True,
                 )
                 hook_thread.start()
+            tenant_seq = (
+                _tenant_schedule(stage.tenants, len(ops))
+                if stage.tenants
+                else None
+            )
             t0 = time.monotonic()
             interval = 1.0 / stage.rate if stage.rate > 0 else 0.0
             for k, op in enumerate(ops):
-                q.put((op, t0 + k * interval))
+                q.put((
+                    op,
+                    t0 + k * interval,
+                    tenant_seq[k] if tenant_seq else None,
+                ))
             for _ in threads:
                 q.put(None)
             # mid-run liveness probe: /debug/slo must serve DURING load
@@ -515,6 +593,9 @@ class LoadHarness:
                     "devcosts": _devcost_delta(
                         dc_before, _devcost_counters(self.uris[0])
                     ),
+                    "qos": _qos_delta(
+                        qo_before, _qos_counters(self.uris[0])
+                    ),
                 }
             )
         wall = time.monotonic() - t_run0
@@ -540,6 +621,8 @@ class LoadHarness:
         # end-of-run ledger state: per-site and per-principal accounting
         # (the tenant-labeled stages show up as principals here)
         devcosts = _fetch_json(self.uris[0], "/debug/devcosts")
+        # end-of-run governor state: per-tenant stages, debt, transitions
+        qos = _fetch_json(self.uris[0], "/debug/qos")
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -556,6 +639,7 @@ class LoadHarness:
             rescache=rescache,
             planner=planner,
             devcosts=devcosts,
+            qos=qos,
         )
 
 

@@ -26,7 +26,7 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
 def build_report(
     config: dict,
     stages: list[dict],
-    records: list[tuple[str, float, float, bool, int]],
+    records: list[tuple[str, float, float, bool, int, str | None]],
     client_errors: int,
     wall_seconds: float,
     sequence_fingerprint: str,
@@ -39,12 +39,14 @@ def build_report(
     rescache: dict | None = None,
     planner: dict | None = None,
     devcosts: dict | None = None,
+    qos: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
-    service_latency_s, ok, http_status)."""
+    service_latency_s, ok, http_status, tenant)."""
     by_class: dict[str, dict] = {}
-    for op_class, lat, svc, ok, _status in records:
+    by_tenant: dict[str, dict] = {}
+    for op_class, lat, svc, ok, status, tenant in records:
         c = by_class.setdefault(
             op_class,
             {"count": 0, "errors": 0, "lat": [], "svc": []},
@@ -54,6 +56,21 @@ def build_report(
             c["errors"] += 1
         c["lat"].append(lat)
         c["svc"].append(svc)
+        if tenant:
+            t = by_tenant.setdefault(
+                tenant,
+                {"count": 0, "errors": 0, "shed": 0, "lat": []},
+            )
+            t["count"] += 1
+            if not ok:
+                t["errors"] += 1
+            if status == 429:
+                t["shed"] += 1
+            # Shed requests answer in microseconds; folding them into the
+            # tenant's latency would make a heavily-shed aggressor look
+            # FAST.  Percentiles are over answered-with-data ops only.
+            if status != 429:
+                t["lat"].append(lat)
     ops_out: dict[str, dict] = {}
     for name, c in sorted(by_class.items()):
         lat = sorted(c["lat"])
@@ -67,6 +84,17 @@ def build_report(
             "p999Ms": _ms(_percentile(lat, 0.999)),
             "serviceP50Ms": _ms(_percentile(svc, 0.50)),
             "serviceP99Ms": _ms(_percentile(svc, 0.99)),
+        }
+    tenants_out: dict[str, dict] = {}
+    for name, t in sorted(by_tenant.items()):
+        lat = sorted(t["lat"])
+        tenants_out[name] = {
+            "count": t["count"],
+            "errors": t["errors"],
+            "shed": t["shed"],
+            "shedRatio": t["shed"] / t["count"] if t["count"] else 0.0,
+            "p50Ms": _ms(_percentile(lat, 0.50)),
+            "p99Ms": _ms(_percentile(lat, 0.99)),
         }
     total_ops = sum(c["count"] for c in ops_out.values())
     verdicts: dict[str, dict] = {}
@@ -122,6 +150,15 @@ def build_report(
         # (tenant, index, opClass) principals; per-stage entries carry
         # the compile/launch/transfer deltas observed while each ran
         "devcosts": devcosts,
+        # client-side per-tenant view of multi-tenant stages
+        # (StageSpec.tenants): shed counts ride separately and are kept
+        # OUT of the latency percentiles, so the aggressor's 429s don't
+        # masquerade as fast service
+        "opsByTenant": tenants_out,
+        # end-of-run QoS governor snapshot (docs/robustness.md "Governed
+        # admission"): per-tenant stage/debt/shed counters plus the
+        # pressure-ladder transition journal observed during the run
+        "qos": qos,
         "verdicts": verdicts,
         "pass": overall,
     }

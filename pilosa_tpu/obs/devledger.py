@@ -38,7 +38,12 @@ from collections import deque
 from contextvars import ContextVar
 
 TENANT_HEADER = "X-Pilosa-Tenant"
-DEFAULT_TENANT = "-"
+# THE canonical tenantless principal: every spelling of "no tenant"
+# (missing header, empty string, whitespace, the legacy "-") lands
+# here, so batcher admission, ledger rows and SLO accounting agree on
+# one identity for untagged traffic (ISSUE 18 satellite).
+DEFAULT_TENANT = "(default)"
+_LEGACY_TENANTLESS = ("-",)
 
 # Reserved site name for compile events no window or claim ever adopted
 # (e.g. module-import-time warmers on threads that never dispatch).
@@ -73,11 +78,18 @@ def active_window_site():
 
 
 def clean_tenant(raw) -> str:
-    """Sanitize a tenant label from the wire: printable, bounded, non-empty."""
+    """Sanitize a tenant label from the wire: printable, bounded,
+    non-empty — and NORMALIZED: every tenantless spelling (None, "",
+    whitespace, legacy "-") maps to the one canonical
+    :data:`DEFAULT_TENANT` so per-tenant accounting never splits
+    untagged traffic across aliases."""
     if not raw:
         return DEFAULT_TENANT
     t = "".join(c for c in str(raw).strip() if c.isprintable() and c not in '{}",\\')
-    return t[:_MAX_TENANT_LEN] or DEFAULT_TENANT
+    t = t[:_MAX_TENANT_LEN]
+    if not t or t in _LEGACY_TENANTLESS:
+        return DEFAULT_TENANT
+    return t
 
 
 def current_tenant() -> str:
@@ -651,6 +663,30 @@ class Ledger:
                 )
         return out
 
+    def tenant_totals(self) -> dict:
+        """Per-TENANT aggregation over the principal table — the QoS
+        governor's debt read-side (server/qos.py debits weighted-fair
+        queues by these measured device-ms, not by query counts)."""
+        with self._lock:
+            out: dict = {}
+            for (tenant, _idx, _cls), row in self._principals.items():
+                t = out.get(tenant)
+                if t is None:
+                    t = out[tenant] = {
+                        "deviceMs": 0.0,
+                        "compileMs": 0.0,
+                        "launches": 0,
+                        "transferBytes": 0,
+                    }
+                t["deviceMs"] += row.device_ms
+                t["compileMs"] += row.compile_ms
+                t["launches"] += row.launches
+                t["transferBytes"] += row.h2d_bytes + row.d2h_bytes
+        for t in out.values():
+            t["deviceMs"] = round(t["deviceMs"], 3)
+            t["compileMs"] = round(t["compileMs"], 3)
+        return out
+
     def snapshot(self) -> dict:
         uptime = max(time.monotonic() - self.started, 1e-9)
         with self._lock:
@@ -793,6 +829,10 @@ def snapshot() -> dict:
 
 def counters() -> dict:
     return _LEDGER.counters()
+
+
+def tenant_totals() -> dict:
+    return _LEDGER.tenant_totals()
 
 
 def prometheus_text() -> str:

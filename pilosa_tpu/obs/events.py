@@ -49,6 +49,7 @@ EVENT_CIRCUIT_BREAKER = "circuit-breaker"
 EVENT_SNAPSHOT = "snapshot"              # fragment op-log compaction
 EVENT_FAULT_INJECTED = "fault-injected"  # testing/faults.py rule fired
 EVENT_INCIDENT = "incident"              # flight recorder auto-capture
+EVENT_QOS = "qos-transition"             # pressure-ladder stage change
 
 
 class EventJournal:

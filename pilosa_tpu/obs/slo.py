@@ -43,6 +43,8 @@ import math
 import threading
 import time
 
+from pilosa_tpu.obs import devledger
+
 # -- op classes ---------------------------------------------------------
 
 OP_READ_COUNT = "read.count"
@@ -95,6 +97,24 @@ def take_class() -> str | None:
     if c is not None:
         _op_class.set(None)
     return c
+
+
+# -- per-tenant dimension ------------------------------------------------
+
+# A tenant-scoped objective class is spelled "op_class@tenant": the
+# tracker records a tenant's request under BOTH the base class and the
+# tenant class, so global burn math is undisturbed while a tenant can
+# carry its own objective/error budget (the QoS governor's per-victim
+# signal, server/qos.py).
+_TENANT_SEP = "@"
+
+# Distinct non-default tenants auto-tracked without an explicit
+# objective; bounds the /metrics class cardinality.
+_MAX_TRACKED_TENANTS = 32
+
+
+def tenant_class(op_class: str, tenant: str) -> str:
+    return f"{op_class}{_TENANT_SEP}{tenant}"
 
 
 # -- latency buckets ----------------------------------------------------
@@ -328,25 +348,51 @@ class SLOTracker:
         )
         self._lock = threading.Lock()
         self._classes: dict[str, _ClassState] = {}
+        self._tenants_seen: set[str] = set()
         self.started = time.monotonic()
 
     # -- recording -----------------------------------------------------
 
-    def observe(self, op_class: str, seconds: float, error: bool = False) -> None:
+    def observe(
+        self,
+        op_class: str,
+        seconds: float,
+        error: bool = False,
+        tenant: str | None = None,
+    ) -> None:
+        """Record one request.  With ``tenant`` set, the request also
+        lands under the tenant-scoped class ``op_class@tenant`` —
+        always when that class carries an objective, and for up to
+        ``_MAX_TRACKED_TENANTS`` distinct non-default tenants besides
+        (cardinality stays bounded; the default tenant's traffic IS
+        the base class, so it gets no duplicate row)."""
         bucket = _bucket_of(seconds)
         now = time.monotonic()
         with self._lock:
-            st = self._classes.get(op_class)
-            if st is None:
-                st = self._classes[op_class] = _ClassState(
-                    self.slot_seconds, self._max_window
-                )
-            st.total += 1
-            if error:
-                st.errors += 1
-            st.ring.observe(now, error, bucket)
-            st.lat_buckets[bucket] += 1
-            st.lat_sum += seconds
+            keys = [op_class]
+            if tenant:
+                tkey = tenant_class(op_class, tenant)
+                track = tkey in self.objectives
+                if not track and tenant != devledger.DEFAULT_TENANT:
+                    if tenant in self._tenants_seen:
+                        track = True
+                    elif len(self._tenants_seen) < _MAX_TRACKED_TENANTS:
+                        self._tenants_seen.add(tenant)
+                        track = True
+                if track:
+                    keys.append(tkey)
+            for key in keys:
+                st = self._classes.get(key)
+                if st is None:
+                    st = self._classes[key] = _ClassState(
+                        self.slot_seconds, self._max_window
+                    )
+                st.total += 1
+                if error:
+                    st.errors += 1
+                st.ring.observe(now, error, bucket)
+                st.lat_buckets[bucket] += 1
+                st.lat_sum += seconds
 
     def attach_exemplar(
         self, op_class: str, seconds: float, trace_id: str
@@ -457,6 +503,26 @@ class SLOTracker:
             "uptimeSeconds": now - self.started,
             "classes": out_classes,
         }
+
+    def pressure(self) -> dict:
+        """Control-loop tap for the QoS governor (server/qos.py):
+        which objective-bearing classes are burning (any rule firing)
+        or violating their latency objective right now.  Derived from
+        the live snapshot — tenant-scoped classes (``op@tenant``)
+        appear here like any other, which is what lets the ladder see
+        a single victim's budget burning."""
+        snap = self.snapshot()
+        alerts: list[tuple[str, str]] = []
+        latency: list[str] = []
+        for name, c in snap["classes"].items():
+            if c["objective"] is None:
+                continue
+            for rule, firing in c["alerts"].items():
+                if firing:
+                    alerts.append((name, rule))
+            if c["latencyOk"] is False:
+                latency.append(name)
+        return {"alerts": alerts, "latency": latency}
 
     def summary(self) -> dict:
         """Compact block for /debug/vars: totals and verdicts only."""
@@ -579,15 +645,37 @@ def objectives_from_dict(spec: dict) -> dict[str, Objective]:
     """Build an objectives map from a plain-dict config (NodeServer /
     InProcessCluster knob): ``{class: {"availability": 0.999,
     "latencyP99Ms": 50}}``.  Starts from the defaults; a class mapped
-    to None drops its objective."""
+    to None drops its objective.
+
+    The PER-TENANT dimension rides a ``"tenants"`` sub-spec::
+
+        {"tenants": {"victim": {"read.count": {"availability": 0.99,
+                                               "latencyP99Ms": 500}}}}
+
+    which expands to tenant-scoped classes (``read.count@victim``) —
+    the tracker then budgets that tenant's traffic separately and the
+    QoS pressure ladder can defend it by name."""
+    spec = dict(spec or {})
+    tenants = spec.pop("tenants", None) or {}
     out = dict(DEFAULT_OBJECTIVES)
-    for name, o in (spec or {}).items():
-        if o is None:
-            out.pop(name, None)
-            continue
+
+    def build(o):
         lat_ms = o.get("latencyP99Ms")
-        out[name] = Objective(
+        return Objective(
             o.get("availability", 0.999),
             lat_ms / 1e3 if lat_ms is not None else None,
         )
+
+    for name, o in spec.items():
+        if o is None:
+            out.pop(name, None)
+            continue
+        out[name] = build(o)
+    for tenant, classes in tenants.items():
+        for name, o in (classes or {}).items():
+            key = tenant_class(name, tenant)
+            if o is None:
+                out.pop(key, None)
+                continue
+            out[key] = build(o)
     return out

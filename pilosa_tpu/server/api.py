@@ -22,6 +22,7 @@ from pilosa_tpu.cluster.client import ClientError
 from pilosa_tpu.obs import devledger
 from pilosa_tpu.obs import events as ev
 from pilosa_tpu.obs import qprofile, slo
+from pilosa_tpu.server import qos as qos_mod
 from pilosa_tpu.testing import faults
 from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
@@ -87,6 +88,14 @@ class API:
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
         planner_enabled: bool = True,
+        qos_enabled: bool = True,
+        qos_weights: dict | None = None,
+        qos_down_factor: float = 8.0,
+        qos_stage_hold: float = 2.0,
+        qos_relax_hold: float = 5.0,
+        qos_tick_interval: float = 0.25,
+        qos_retry_after: float = 1.0,
+        qos_aggressor_share: float = 0.5,
     ):
         self.holder = holder or Holder()
         self.store = store
@@ -161,10 +170,36 @@ class API:
         # single-node clusters and dispatch mesh-complete flights as one
         # sharded launch (cluster/dist.py execute_batch).
         from pilosa_tpu.server.batcher import QueryBatcher
+        from pilosa_tpu.server.qos import QosGovernor
 
         self.batcher = None
         self.prefetcher = None
+        self.qos = None
         if batch_window > 0 and batch_max_size > 1:
+            # Cost-governed multi-tenant admission (server/qos.py):
+            # weighted-fair queues debited by measured device-ms, plus
+            # the deprioritize/degrade/shed pressure ladder.  The
+            # control-loop taps are callables so the flight recorder
+            # (installed later by NodeServer) is picked up live.
+            self.qos = QosGovernor(
+                stats=self.holder.stats,
+                weights=qos_weights,
+                enabled=qos_enabled,
+                down_factor=qos_down_factor,
+                stage_hold=qos_stage_hold,
+                relax_hold=qos_relax_hold,
+                tick_interval=qos_tick_interval,
+                retry_after=qos_retry_after,
+                aggressor_share=qos_aggressor_share,
+                slo_fn=lambda: self.holder.slo,
+                ledger_fn=devledger.tenant_totals,
+                journal_fn=lambda: self.holder.events,
+                incident_fn=lambda trig: (
+                    self.flightrec.capture_incident(trig)
+                    if self.flightrec is not None
+                    else None
+                ),
+            )
             # Predictive residency prefetch (server/prefetch.py): the
             # batcher's admission queue resolves each flight's cold
             # fragments onto the ingest uploader's low-priority lane, so
@@ -182,6 +217,7 @@ class API:
                 window=batch_window,
                 max_batch=batch_max_size,
                 prefetcher=self.prefetcher,
+                qos=self.qos,
             )
         # Online-migration state (cluster/migration.py): source-side
         # session registry (snapshot cut + delta tap per in-flight
@@ -286,6 +322,12 @@ class API:
                     else:
                         results = self._execute_query(index, pql, shards)
                         resp = {"results": result_to_json(results)}
+                        # Degraded tier is EXPLICIT: a last-known
+                        # answer served under QoS pressure stage 2 is
+                        # marked in the envelope (server/qos.py sets
+                        # the request-scoped note in batcher.submit)
+                        if qos_mod.take_degraded():
+                            resp["degraded"] = True
                 except (ExecuteError, ParseError, ValueError, TypeError) as e:
                     err = str(e)
                     raise ApiError(str(e))
@@ -1011,6 +1053,14 @@ class API:
     def slo_snapshot(self) -> dict:
         """Live per-op-class objective state (/debug/slo)."""
         return self.holder.slo.snapshot()
+
+    def qos_snapshot(self) -> dict:
+        """Cost-governed admission state (/debug/qos): per-tenant
+        weighted-fair queue rows, ladder stages, shed/degraded counts
+        and recent transitions (server/qos.py)."""
+        if self.qos is None:
+            return {"enabled": False, "tenants": {}, "transitions": []}
+        return self.qos.snapshot()
 
     # -- trace plane (tail-sampled store, /debug/traces) --------------------
 

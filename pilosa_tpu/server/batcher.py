@@ -53,6 +53,16 @@ flight of them dispatches as ONE jit-sharded launch via
 ``DistributedExecutor.execute_batch``; fan-outs with off-mesh owners
 keep the direct path — that leg has its own per-hop batching story
 (ROADMAP item 4).
+
+Admission is COST-GOVERNED, not FIFO (server/qos.py): each tenant has
+a virtual-time weighted-fair queue whose debt is debited by the
+devledger's measured per-tenant device-ms, and the governor's pressure
+ladder can deprioritize, degrade (TopN/GroupBy from last-known
+semantic-cache entries, marked in the response) or shed (429 +
+Retry-After via :class:`~pilosa_tpu.server.qos.ShedError`) an
+aggressor tenant when SLO burn alerts fire.  The governor object IS
+the queue — it presents ``put``/``get``/``empty`` to the dispatcher
+loop below, so window policy and drain semantics are unchanged.
 """
 
 from __future__ import annotations
@@ -65,6 +75,7 @@ import time
 from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
 from pilosa_tpu.obs import devledger, qprofile
+from pilosa_tpu.server import qos as qos_mod
 
 logger = logging.getLogger(__name__)
 
@@ -113,6 +124,7 @@ class QueryBatcher:
         window: float = 0.002,
         max_batch: int = 64,
         prefetcher=None,
+        qos=None,
     ):
         self.executor = executor
         # Flight-driven predictive prefetch (server/prefetch.py): the
@@ -127,7 +139,16 @@ class QueryBatcher:
         self.stats = stats if hasattr(stats, "gauge") else None
         self.window = float(window)
         self.max_batch = int(max_batch)
-        self._q: queue.Queue = queue.Queue()  # graftlint: disable=queue-discipline -- depth is bounded by the HTTP handler threads: each blocks on its own flight's result before submitting again
+        # The QoS governor doubles as the admission queue: per-tenant
+        # virtual-time weighted-fair queues behind the queue.Queue
+        # surface the dispatcher loop expects.  A standalone batcher
+        # (no server wiring) gets a ladder-disabled governor — WFQ
+        # scheduling is always on, pressure control needs SLO/ledger
+        # taps.  graftlint: disable=queue-discipline -- depth is bounded by the HTTP handler threads: each blocks on its own flight's result before submitting again
+        self.qos = qos if qos is not None else qos_mod.QosGovernor(
+            stats=stats, enabled=False
+        )
+        self._q = self.qos
         self._lock = threading.Lock()
         self._closed = False
         self._depth = 0  # submitted, not yet demuxed (includes in-flight)
@@ -147,11 +168,56 @@ class QueryBatcher:
         in-order per-request semantics on the direct path."""
         return not self._closed and not query.write_calls()
 
+    def _count_expired(self, tenant: str, reason: str) -> None:
+        """Per-tenant, per-reason expiry counter (``batcher_expired``
+        keeps its original meaning: expired while queued).  Incident
+        bundles can then tell shed (qos_shed) from expired apart."""
+        if self.stats is not None:
+            self.stats.count_with_tags(
+                "batcher_expired_by",
+                1,
+                1.0,
+                (f"tenant:{tenant}", f"reason:{reason}"),
+            )
+
+    @staticmethod
+    def _degradable(query) -> bool:
+        """Only TopN/GroupBy ride the degraded tier: those are the
+        shapes PR 14 maintains views for, so a last-known answer is a
+        meaningful dashboard, not a stale scalar."""
+        calls = getattr(query, "calls", None)
+        return bool(calls) and all(
+            getattr(c, "name", "") in ("TopN", "GroupBy") for c in calls
+        )
+
     def submit(self, index: str, query, shards=None) -> list:
         """Block the calling handler thread until its flight lands;
         returns the query's results or raises its error.  Runs in the
         request's own deadline scope and profile context."""
-        deadline.check("batcher admission")
+        tenant = devledger.current_tenant()
+        try:
+            deadline.check("batcher admission")
+        except DeadlineExceeded:
+            self._count_expired(tenant, "admission")
+            raise
+        # Admission control FIRST: a stage-3 tenant is shed (429 +
+        # Retry-After upstream) before it can reach the deadline-bypass
+        # or cache-probe fast paths — backpressure must not be dodged
+        # by tightening the request budget.
+        decision = self.qos.admit(
+            tenant, can_degrade=self._degradable(query)
+        )
+        if decision == qos_mod.DEGRADE:
+            stale = getattr(self.executor, "rescache_degraded", None)
+            served = stale(index, query, shards) if stale is not None else None
+            if served is not None:
+                # explicitly-marked degraded tier: API.query() stamps
+                # the response envelope from this request-scoped note
+                qos_mod.note_degraded()
+                self.qos.note_degraded_served(tenant)
+                return served
+            # no last-known answer: fall through and run it for real
+            # (at the tenant's stage-reduced weight)
         if deadline.would_expire_within(self.window):
             # Too close to the budget to queue: dispatch-now beats
             # queue-then-504 (the request still pays only its own work).
@@ -198,6 +264,7 @@ class QueryBatcher:
         if not item.event.wait(rem if rem is not None else None):
             # our own budget died while queued/dispatching; the
             # dispatcher will still demux into the abandoned slot
+            self._count_expired(tenant, "dispatch-wait")
             raise DeadlineExceeded("deadline exceeded (batched dispatch)")
         qprofile.annotate(
             "batcher.queueWait",
@@ -234,6 +301,10 @@ class QueryBatcher:
                 except Exception:
                     logger.debug("flight prefetch failed", exc_info=True)
             self._dispatch(batch, reason)
+            # governor control loop rides the dispatcher cadence (it
+            # has no thread of its own); admission paths tick it too,
+            # so a quiet dispatcher still relaxes the ladder
+            self.qos.maybe_tick()
 
     def _urgent(self, item: _Flight) -> bool:
         return (
@@ -296,6 +367,7 @@ class QueryBatcher:
                 )
                 if stats is not None:
                     stats.count("batcher_expired", 1, 1.0)
+                self._count_expired(item.principal[0], "batch-queue")
             else:
                 ready.append(item)
         t0 = time.monotonic()

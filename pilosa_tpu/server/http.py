@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import re
 import threading
 import time
@@ -37,6 +38,7 @@ from pilosa_tpu import deadline
 from pilosa_tpu.deadline import DeadlineExceeded
 from pilosa_tpu.obs import devledger, slo, tracestore, tracing
 from pilosa_tpu.server.api import API, ApiError
+from pilosa_tpu.server.qos import ShedError
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +63,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/slo$"), "debug_slo"),
+    ("GET", re.compile(r"^/debug/qos$"), "debug_qos"),
     ("GET", re.compile(r"^/debug/slow-queries$"), "debug_slow_queries"),
     ("GET", re.compile(r"^/debug/threads$"), "debug_threads"),
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
@@ -126,15 +129,23 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug(fmt, *args)
 
-    def _send(self, code: int, body: bytes, content_type: str = "application/json") -> None:
+    def _send(
+        self,
+        code: int,
+        body: bytes,
+        content_type: str = "application/json",
+        headers: dict | None = None,
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj) -> None:
-        self._send(code, (json.dumps(obj) + "\n").encode())
+    def _send_json(self, code: int, obj, headers: dict | None = None) -> None:
+        self._send(code, (json.dumps(obj) + "\n").encode(), headers=headers)
 
     def _body(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
@@ -206,12 +217,27 @@ class Handler(BaseHTTPRequestHandler):
                 try:
                     # Tenant attribution: the device cost ledger books
                     # every launch this request causes under the header's
-                    # tenant (default "-"); the contextvar rides into the
-                    # api/executor layers and batcher flight snapshots.
+                    # tenant (canonical "(default)" when untagged); the
+                    # contextvar rides into the api/executor layers and
+                    # batcher flight snapshots.
                     with devledger.tenant_scope(
                         self.headers.get(devledger.TENANT_HEADER)
                     ), deadline.scope(self._request_budget()):
                         getattr(self, "r_" + name)(**match.groupdict())
+                except ShedError as e:
+                    # QoS load shed (server/qos.py stage 3): explicit
+                    # 429 + Retry-After, NEVER a silent 504 — and a 4xx,
+                    # so backpressure does not burn the error budget it
+                    # exists to protect.
+                    retry = max(1, math.ceil(e.retry_after))
+                    self.api.holder.stats.count_with_tags(
+                        "http_shed", 1, 1.0, (f"tenant:{e.tenant}",)
+                    )
+                    self._send_json(
+                        429,
+                        {"error": str(e), "retryAfter": retry},
+                        headers={"Retry-After": str(retry)},
+                    )
                 except DeadlineExceeded as e:
                     # Distinct from ApiError (400-family): a spent budget
                     # is a timeout, not a client mistake (reference maps
@@ -240,7 +266,16 @@ class Handler(BaseHTTPRequestHandler):
                         span.set_tag("error", True)
                     span.__exit__(None, None, None)
                     tracestore._active_store.reset(store_token)
-                    self.api.holder.slo.observe(op_class, elapsed, slo_error)
+                    # Per-tenant SLO dimension: the request also lands
+                    # under "op_class@tenant" (obs/slo.py) so a single
+                    # tenant's objective/error budget is trackable —
+                    # the QoS ladder's per-victim pressure signal.
+                    tenant = devledger.clean_tenant(
+                        self.headers.get(devledger.TENANT_HEADER)
+                    )
+                    self.api.holder.slo.observe(
+                        op_class, elapsed, slo_error, tenant=tenant
+                    )
                     self.api.holder.stats.count_with_tags(
                         "http_requests", 1, 1.0, (f"route:{name}",)
                     )
@@ -369,6 +404,9 @@ class Handler(BaseHTTPRequestHandler):
         if batcher is not None:
             # serving-plane block: queue depth, window knobs, flights
             snap["batcher"] = batcher.snapshot()
+        if getattr(self.api, "qos", None) is not None:
+            # cost-governed admission: per-tenant WFQ + ladder stages
+            snap["qos"] = self.api.qos_snapshot()
         ingest = getattr(self.api, "ingest", None)
         if ingest is not None:
             # ingest-plane block: pool depth/inflight, staging occupancy,
@@ -390,6 +428,13 @@ class Handler(BaseHTTPRequestHandler):
         """Live SLO state: per-op-class latency quantiles, windowed
         availability, burn rates, alert firing, pass/fail verdicts."""
         self._send_json(200, self.api.slo_snapshot())
+
+    def r_debug_qos(self):
+        """Cost-governed admission state: per-tenant weighted-fair
+        queues (debt, cost estimate, effective weight), pressure-ladder
+        stages, shed/degraded counters and recent transitions
+        (server/qos.py)."""
+        self._send_json(200, self.api.qos_snapshot())
 
     def r_debug_events(self):
         """Event journal past ?since=<seq> (gap-free cursor resume);

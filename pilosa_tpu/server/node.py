@@ -195,6 +195,14 @@ class NodeServer:
         devledger_storm_threshold: int = 8,
         devledger_storm_window: float = 60.0,
         devledger_warmup: float = 120.0,
+        qos_enabled: bool = True,
+        qos_weights: dict | None = None,
+        qos_down_factor: float = 8.0,
+        qos_stage_hold: float = 2.0,
+        qos_relax_hold: float = 5.0,
+        qos_tick_interval: float = 0.25,
+        qos_retry_after: float = 1.0,
+        qos_aggressor_share: float = 0.5,
     ):
         self.host = host
         # HBM budget override: device memory is process-global (one
@@ -310,6 +318,14 @@ class NodeServer:
             rescache_promote_hits=rescache_promote_hits,
             rescache_demote_deltas=rescache_demote_deltas,
             planner_enabled=planner_enabled,
+            qos_enabled=qos_enabled,
+            qos_weights=qos_weights,
+            qos_down_factor=qos_down_factor,
+            qos_stage_hold=qos_stage_hold,
+            qos_relax_hold=qos_relax_hold,
+            qos_tick_interval=qos_tick_interval,
+            qos_retry_after=qos_retry_after,
+            qos_aggressor_share=qos_aggressor_share,
         )
         self._wire_shard_broadcasts()
         # Route new-key allocation to the translation primary (reference

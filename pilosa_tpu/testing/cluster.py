@@ -48,6 +48,14 @@ class InProcessCluster:
         rescache_promote_hits: int = 3,
         rescache_demote_deltas: int = 64,
         planner_enabled: bool = True,
+        qos_enabled: bool = True,
+        qos_weights: dict | None = None,
+        qos_down_factor: float = 8.0,
+        qos_stage_hold: float = 2.0,
+        qos_relax_hold: float = 5.0,
+        qos_tick_interval: float = 0.25,
+        qos_retry_after: float = 1.0,
+        qos_aggressor_share: float = 0.5,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -77,6 +85,14 @@ class InProcessCluster:
             "rescache_promote_hits": rescache_promote_hits,
             "rescache_demote_deltas": rescache_demote_deltas,
             "planner_enabled": planner_enabled,
+            "qos_enabled": qos_enabled,
+            "qos_weights": qos_weights,
+            "qos_down_factor": qos_down_factor,
+            "qos_stage_hold": qos_stage_hold,
+            "qos_relax_hold": qos_relax_hold,
+            "qos_tick_interval": qos_tick_interval,
+            "qos_retry_after": qos_retry_after,
+            "qos_aggressor_share": qos_aggressor_share,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

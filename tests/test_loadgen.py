@@ -247,8 +247,8 @@ def _fake_report(records):
 
 
 def test_build_report_aggregates_and_verdicts():
-    records = [("write", 0.002, 0.001, True, 200)] * 99 + [
-        ("write", 0.050, 0.040, False, 500)
+    records = [("write", 0.002, 0.001, True, 200, "acme")] * 99 + [
+        ("write", 0.050, 0.040, False, 500, "acme")
     ]
     r = _fake_report(records)
     validate_report(r)
@@ -260,10 +260,28 @@ def test_build_report_aggregates_and_verdicts():
     assert r["verdicts"]["write"]["pass"] is True
     assert r["pass"] is True
     assert r["throughputOpsPerSec"] == pytest.approx(100.0)
+    t = r["opsByTenant"]["acme"]
+    assert t["count"] == 100 and t["errors"] == 1 and t["shed"] == 0
+
+
+def test_build_report_tenant_latency_excludes_sheds():
+    # 429s must not drag a heavily-shed tenant's percentiles DOWN:
+    # shed answers are microseconds, not service.
+    records = [("read.count", 0.100, 0.090, True, 200, "agg")] * 10 + [
+        ("read.count", 0.0001, 0.0001, False, 429, "agg")
+    ] * 90
+    r = _fake_report(records)
+    t = r["opsByTenant"]["agg"]
+    assert t["count"] == 100 and t["shed"] == 90
+    assert t["shedRatio"] == pytest.approx(0.9)
+    assert t["p50Ms"] == pytest.approx(100.0)  # answered ops only
+    # tenantless records build no tenant row
+    r2 = _fake_report([("write", 0.001, 0.001, True, 200, None)])
+    assert r2["opsByTenant"] == {}
 
 
 def test_validate_report_rejects_broken_schemas():
-    good = _fake_report([("write", 0.001, 0.001, True, 200)])
+    good = _fake_report([("write", 0.001, 0.001, True, 200, None)])
     with pytest.raises(ValueError):
         validate_report({**good, "schema": "bogus/v0"})
     with pytest.raises(ValueError):

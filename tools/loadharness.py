@@ -18,6 +18,11 @@ Default stage plan (scaled by --duration/--rate/--workers):
                    template pool with interleaved writes — the semantic
                    result cache lane; the report entry carries the
                    stage's cache hit/invalidation deltas
+    overload       two tenants on one open-loop schedule, the aggressor
+                   at 10x the victim's share — the QoS governor's
+                   pressure-ladder lane (docs/robustness.md "Governed
+                   admission"); the report's ``opsByTenant`` and ``qos``
+                   blocks show who was deprioritized/degraded/shed
     ramp           full mix at full rate and concurrency (budget restored)
 
 Examples::
@@ -99,6 +104,36 @@ SHARED_FLIGHT_MIX = {
     "set_val": 6.0,
 }
 SHARED_POOL = 8
+# Overload: the noisy-neighbor shape — one stage, two tenants on the
+# same open-loop arrival schedule, the aggressor at 10x the victim's
+# share (StageSpec.tenants weighted interleave).  TopN/GroupBy carry
+# real weight so stage-2 of the pressure ladder has degradable traffic
+# to serve from maintained views / last-known cache entries.
+OVERLOAD_MIX = {
+    "count": 30.0, "topn": 22.0, "groupby": 18.0, "row": 10.0,
+    "range_bsi": 8.0, "set": 8.0, "translate": 4.0,
+}
+OVERLOAD_TENANTS = {"victim": 1.0, "aggressor": 10.0}
+# Per-tenant SLO objective for the victim (slo.objectives_from_dict
+# "tenants" sub-spec): lenient latency — the point is the RELATIVE
+# contract (victim inside objective while the aggressor floods), not an
+# absolute in-process latency bar.
+OVERLOAD_OBJECTIVES = {
+    "tenants": {
+        "victim": {
+            "read.count": {"availability": 0.99, "latencyP99Ms": 1000.0},
+        },
+    },
+}
+# Governor knobs shrunk to the harness's time scale (as SHORT_BURN_RULES
+# shrinks the burn windows): fast ticks, sub-second escalation holds.
+QOS_KNOBS = {
+    "qos_enabled": True,
+    "qos_tick_interval": 0.1,
+    "qos_stage_hold": 0.4,
+    "qos_relax_hold": 2.0,
+    "qos_retry_after": 1.0,
+}
 
 
 def oversub_budget() -> int:
@@ -117,27 +152,35 @@ def oversub_budget() -> int:
 
 
 def default_stages(duration: float, rate: float, workers: int) -> list[StageSpec]:
-    seventh = max(1.0, duration / 7.0)
+    eighth = max(1.0, duration / 8.0)
     return [
-        StageSpec("warm", seventh, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
-        StageSpec("timequantum", seventh, rate, workers, TIMEQUANTUM_MIX),
-        StageSpec("rangescan", seventh, rate, workers, RANGE_HEAVY_MIX),
+        StageSpec("warm", eighth, rate / 2.0, max(1, workers // 2), READ_HEAVY_MIX),
+        StageSpec("timequantum", eighth, rate, workers, TIMEQUANTUM_MIX),
+        StageSpec("rangescan", eighth, rate, workers, RANGE_HEAVY_MIX),
         StageSpec(
-            "oversubscribed", seventh, rate, workers, OVERSUB_MIX,
+            "oversubscribed", eighth, rate, workers, OVERSUB_MIX,
             device_budget=oversub_budget(),
         ),
         StageSpec(
-            "repeatread", seventh, rate, workers, REPEAT_READ_MIX,
+            "repeatread", eighth, rate, workers, REPEAT_READ_MIX,
             repeat_pool=REPEAT_POOL,
             # tenant-labeled stage: its device work lands under the
             # "dashboards" principal in the report's devcosts block
             tenant="dashboards",
         ),
         StageSpec(
-            "sharedflight", seventh, rate, workers, SHARED_FLIGHT_MIX,
+            "sharedflight", eighth, rate, workers, SHARED_FLIGHT_MIX,
             shared_pool=SHARED_POOL,
         ),
-        StageSpec("ramp", seventh, rate * 1.5, workers, None),
+        StageSpec(
+            # 2x the base rate so the governor actually sees pressure;
+            # the aggressor's sheds drag this stage's availability below
+            # the floor BY DESIGN — the victim's per-tenant verdict and
+            # the report's opsByTenant split are the acceptance signal
+            "overload", eighth, rate * 2.0, workers, OVERLOAD_MIX,
+            tenants=OVERLOAD_TENANTS,
+        ),
+        StageSpec("ramp", eighth, rate * 1.5, workers, None),
     ]
 
 
@@ -242,6 +285,8 @@ def main(argv: list[str] | None = None) -> int:
             "slo_slot_seconds": 1.0,
             "slo_latency_window": 60.0,
             "default_deadline": args.default_deadline,
+            "slo_objectives": OVERLOAD_OBJECTIVES,
+            **QOS_KNOBS,
         },
         faults=[parse_fault(f) for f in args.fault],
         preload_bits=args.preload_bits,
@@ -288,6 +333,12 @@ def main(argv: list[str] | None = None) -> int:
             f"{'OK' if st['availabilityOk'] else 'LOW'}"
             + (f" hookError={st['hookError']}" if st.get("hookError") else "")
             + res_note
+        )
+    for name, t in (report.get("opsByTenant") or {}).items():
+        p99 = t["p99Ms"]
+        print(
+            f"  tenant {name:<14} n={t['count']:<6} shed={t['shed']:<5} "
+            + (f"p99={p99:.2f}ms" if p99 is not None else "p99=n/a")
         )
     for name, v in report["verdicts"].items():
         print(f"  verdict {name:<14} {'PASS' if v['pass'] else 'FAIL'}")

@@ -228,14 +228,16 @@ def main() -> int:
 
         # -- device cost ledger: the bursts above drove real batched
         # launches, so /debug/devcosts must already attribute them to
-        # their dispatch sites and to the default "-" tenant principal
+        # their dispatch sites and to the canonical default tenant
+        from pilosa_tpu.obs import devledger
+
         dc = json.loads(_get(f"{base}/debug/devcosts"))
         assert dc["totals"]["launches"] > 0, dc["totals"]
         assert {"exec.astbatch", "ops.kernels", "executor.stack_launch"} <= set(
             dc["sites"]
         ), dc["sites"].keys()
         assert any(s["launches"] > 0 for s in dc["sites"].values()), dc["sites"]
-        assert any(p["tenant"] == "-" and p["launches"] > 0
+        assert any(p["tenant"] == devledger.DEFAULT_TENANT and p["launches"] > 0
                    for p in dc["principals"]), dc["principals"]
         # a tenant-labeled request that forces a FIRST-TIME compile: the
         # write call routes the whole request around the batcher onto

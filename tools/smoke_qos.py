@@ -1,0 +1,159 @@
+"""QoS-governor smoke: boot a real node, drive two tenants through the
+HTTP path — a victim at share 1 and an aggressor at share 10 on one
+open-loop schedule — and assert the cost-governed admission contract
+end to end (docs/robustness.md "Governed admission").
+
+Asserts:
+  * the victim stays inside its per-tenant latency objective while the
+    aggressor floods at 10x (the whole point of the governor)
+  * the aggressor's sheds are visible at /debug/qos AND as
+    pilosa_qos_shed{tenant="aggressor"} in /metrics; the victim is
+    never shed
+  * sheds came back as 429 + Retry-After to the client, not silent 504s
+  * the pressure episode captured EXACTLY ONE qos-pressure incident
+    bundle (transitions are journaled, not incident-spammed)
+  * per-tenant devledger debt shows the aggressor paid for the
+    pressure: its measured device-ms dominates the victim's
+
+Run: python -m tools.smoke_qos        (CI: qos smoke step)
+"""
+
+from __future__ import annotations
+
+import sys
+
+from pilosa_tpu.loadgen import (
+    LoadHarness,
+    StageSpec,
+    WorkloadConfig,
+    validate_report,
+)
+from pilosa_tpu.loadgen.harness import (
+    _fetch_json,
+    _fetch_text,
+    preload,
+    prepare_schema,
+)
+
+BURN_RULES = [
+    {"name": "fast", "long": 60.0, "short": 10.0, "factor": 14.4},
+    {"name": "slow", "long": 300.0, "short": 60.0, "factor": 1.0},
+]
+
+# The pressure source: an intentionally unmeetable base latency
+# objective, so SLOTracker.pressure() reports latency violations from
+# the first burst — the smoke regresses the LADDER, not the absolute
+# speed of an in-process node.  The victim's own (lenient, per-tenant)
+# objective is the one whose verdict must PASS.
+SLO_OBJECTIVES = {
+    "read.count": {"availability": 0.999, "latencyP99Ms": 0.01},
+    "read.topn": {"availability": 0.999, "latencyP99Ms": 0.01},
+    "tenants": {
+        "victim": {
+            "read.count": {"availability": 0.99, "latencyP99Ms": 1000.0},
+        },
+    },
+}
+
+# TopN/GroupBy-weighted so stage 2 has degradable traffic; count keeps
+# the pressured base classes busy.
+MIX = {
+    "count": 34.0, "topn": 22.0, "groupby": 16.0, "row": 10.0,
+    "set": 10.0, "translate": 8.0,
+}
+
+VICTIM_OBJECTIVE_CLASS = "read.count@victim"
+
+
+def main() -> int:
+    from pilosa_tpu.testing.cluster import InProcessCluster
+
+    config = WorkloadConfig(seed=77, n_cols=10_000)
+    stages = [
+        # single-tenant warm-up: establishes ledger cost estimates and
+        # proves the single-active-tenant safety property (no
+        # escalation without a neighbor to defend)
+        StageSpec("warm", 1.0, 60.0, 4, MIX),
+        StageSpec(
+            "overload", 4.0, 250.0, 8, MIX,
+            tenants={"victim": 1.0, "aggressor": 10.0},
+        ),
+    ]
+    with InProcessCluster(
+        1,
+        slo_burn_rules=BURN_RULES,
+        slo_slot_seconds=1.0,
+        slo_latency_window=60.0,
+        slo_objectives=SLO_OBJECTIVES,
+        qos_enabled=True,
+        qos_tick_interval=0.1,
+        qos_stage_hold=0.3,
+        qos_relax_hold=5.0,
+    ) as cluster:
+        prepare_schema(cluster, config)
+        preload(cluster, config, 1024)
+        harness = LoadHarness(
+            [n.uri for n in cluster.nodes], config, stages,
+            # the aggressor's 429s drag raw availability down BY DESIGN
+            availability_floor=0.0,
+        )
+        report = harness.run()
+        uri = cluster.nodes[0].uri
+        metrics = _fetch_text(uri, "/metrics")
+        qos = _fetch_json(uri, "/debug/qos")
+        incidents = _fetch_json(uri, "/debug/incidents")
+
+    validate_report(report)
+    assert report["clientErrors"] == 0, report["clientErrors"]
+
+    # -- the victim held its objective while the aggressor flooded
+    verdicts = report["verdicts"]
+    assert VICTIM_OBJECTIVE_CLASS in verdicts, sorted(verdicts)
+    assert verdicts[VICTIM_OBJECTIVE_CLASS]["pass"], (
+        f"victim blew its objective under aggressor load: "
+        f"{verdicts[VICTIM_OBJECTIVE_CLASS]}"
+    )
+
+    # -- the aggressor was shed; the victim never was
+    tenants = (qos or {}).get("tenants", {})
+    assert "aggressor" in tenants and "victim" in tenants, sorted(tenants)
+    agg, vic = tenants["aggressor"], tenants["victim"]
+    assert agg["shed"] > 0, f"aggressor never shed: {agg}"
+    assert vic["shed"] == 0, f"victim was shed: {vic}"
+    assert 'pilosa_qos_shed{tenant="aggressor"}' in metrics, (
+        "aggressor sheds missing from /metrics"
+    )
+
+    # -- sheds surfaced to the client as 429 + Retry-After, not 504s
+    by_tenant = report["opsByTenant"]
+    assert by_tenant["aggressor"]["shed"] > 0, by_tenant
+    assert by_tenant["victim"]["shed"] == 0, by_tenant
+
+    # -- exactly one qos-pressure incident for the episode
+    bundles = (incidents or {}).get("incidents", [])
+    qos_incidents = [
+        b for b in bundles
+        if (b.get("trigger") or {}).get("type") == "qos-pressure"
+    ]
+    assert len(qos_incidents) == 1, (
+        f"want exactly 1 qos-pressure incident, got {len(qos_incidents)}: "
+        f"{[b.get('trigger') for b in bundles]}"
+    )
+
+    # -- the aggressor paid for the pressure in measured device-ms
+    assert agg["debtMs"] > vic["debtMs"], (
+        f"aggressor debt {agg['debtMs']}ms must dominate "
+        f"victim debt {vic['debtMs']}ms"
+    )
+
+    print(
+        f"qos smoke OK: aggressor shed={agg['shed']} "
+        f"debt={agg['debtMs']:.1f}ms stage={agg['stageName']}; "
+        f"victim shed=0 debt={vic['debtMs']:.1f}ms "
+        f"p99={by_tenant['victim']['p99Ms']:.1f}ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
