@@ -487,6 +487,90 @@ def _recorder_overhead_lane() -> dict:
     }
 
 
+def _history_overhead_lane() -> dict:
+    """Metrics-history overhead lane (recorder-lane shape): the same
+    served query loop against two freshly booted nodes — one with the
+    ring-TSDB sampler + trend detectors live (obs/history.py, the
+    serving default; cadence pinned at 2x production so the lane
+    exercises the sampler rather than the gap between ticks) and one
+    with the history plane off — interleaved blocks, best-block compare.
+    Target: <= 5% qps."""
+    import http.client
+
+    from pilosa_tpu.server.node import NodeServer
+
+    def boot(history: bool):
+        # rescache off for the same reason as the recorder lane: a
+        # cache hit skips the execution whose planes the sampler reads
+        srv = NodeServer(
+            port=0,
+            history_enabled=history,
+            history_cadence=0.5,
+            rescache_entries=0,
+        )
+        srv.start()
+        api = srv.api
+        api.create_index("hist")
+        api.create_field("hist", "f")
+        rng = np.random.default_rng(17)
+        width = api.holder.n_words * 32
+        writes = [
+            f"Set({int(c)}, f={row})"
+            for row in range(4)
+            for c in rng.integers(0, width, size=150)
+        ]
+        api.query("hist", " ".join(writes))
+        conn = http.client.HTTPConnection(
+            srv.host, srv.server.port, timeout=60
+        )
+        body = b"Count(Intersect(Row(f=0), Row(f=1)))"
+
+        def once() -> None:
+            conn.request("POST", "/index/hist/query", body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"history lane HTTP {resp.status}: {data[:120]!r}"
+                )
+
+        return srv, conn, once
+
+    srv_on, conn_on, once_on = boot(True)
+    srv_off, conn_off, once_off = boot(False)
+    try:
+        for once in (once_on, once_off):
+            for _ in range(50):
+                once()
+        reps, best_on, best_off = 200, 0.0, 0.0
+        for _ in range(5):
+            for once, which in ((once_off, "off"), (once_on, "on")):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    once()
+                qps = reps / (time.perf_counter() - t0)
+                if which == "on":
+                    best_on = max(best_on, qps)
+                else:
+                    best_off = max(best_off, qps)
+        sampler = (
+            srv_on.history.stats() if srv_on.history is not None else None
+        )
+        conn_on.close()
+        conn_off.close()
+    finally:
+        srv_on.stop()
+        srv_off.stop()
+    return {
+        "qps_history_on": round(best_on, 1),
+        "qps_history_off": round(best_off, 1),
+        "overhead_frac": (
+            round(1.0 - best_on / best_off, 4) if best_off else None
+        ),
+        "sampler": sampler,
+    }
+
+
 def _mesh_dist_lane() -> dict:
     """Cluster-on-mesh lane: distributed Count/TopN/Range on an in-mesh
     8-way InProcessCluster — every owner's fragments are slices of the
@@ -1413,6 +1497,15 @@ def main() -> None:
     except Exception as e:
         print(f"warning: recorder overhead lane failed: {e}", file=sys.stderr)
 
+    # -- metrics-history overhead: served qps with the ring-TSDB
+    # sampler + trend detectors on vs off (the lane must never sink
+    # the bench)
+    history_lane = None
+    try:
+        history_lane = _history_overhead_lane()
+    except Exception as e:
+        print(f"warning: history overhead lane failed: {e}", file=sys.stderr)
+
     # -- cluster-on-mesh lane: distributed Count/TopN/Range answered as
     # one jit-sharded launch over an in-mesh 8-way cluster, vs the same
     # data on a single holder (the lane must never sink the bench)
@@ -1979,6 +2072,9 @@ def main() -> None:
         # incident-plane cost: overhead_frac is (1 - on/off); the
         # acceptance bar for the always-on recorder is <= 0.05
         "recorder_overhead": recorder_lane,
+        # metrics-history cost (obs/history.py sampler + trend
+        # detectors at 2x production cadence): same <= 0.05 bar
+        "history_overhead": history_lane,
         # tiered-residency lane: oversubscribed_vs_resident >= 0.25 and
         # prefetch_useful_frac >= 0.5 are the working-set manager's bars
         # (docs/residency.md)

@@ -671,6 +671,30 @@ class InternalClient:
             "GET", uri, f"/debug/traces?id={trace_id}&spans=true"
         )
 
+    def debug_history(
+        self,
+        uri: str,
+        series=None,
+        since: int | None = None,
+        step: float | None = None,
+        limit: int | None = None,
+    ) -> dict:
+        """Pull a peer's local metrics-history window (the cluster
+        timeline merge fans out through here)."""
+        params = []
+        if series:
+            if not isinstance(series, str):
+                series = ",".join(series)
+            params.append("series=" + urllib.parse.quote(series, safe=""))
+        if since is not None:
+            params.append(f"since={int(since)}")
+        if step is not None:
+            params.append(f"step={float(step)}")
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        qs = ("?" + "&".join(params)) if params else ""
+        return self._json("GET", uri, f"/debug/history{qs}")
+
     def shards_max(self, uri: str) -> dict:
         """Per-index max shard seen by ``uri`` (reference
         client.go:176 MaxShardByIndex)."""
@@ -782,6 +806,10 @@ class NopInternalClient:
 
     def debug_events(self, uri, since=0):
         return {"events": [], "nextSeq": since, "truncated": False}
+
+    def debug_history(self, uri, series=None, since=None, step=None,
+                      limit=None):
+        return {"series": {}, "nextSeq": 0, "truncated": False}
 
     def debug_traces(self, uri, limit=100):
         return {"traces": []}

@@ -408,6 +408,55 @@ def _qos_delta(before: dict | None, after: dict | None) -> dict | None:
     return out
 
 
+# per-stage history embedding: the headline series whose windowed stats
+# land in each stage's report entry (full point lists stay on the node)
+_HISTORY_STAGE_SERIES = (
+    "slo.*.p99_ms,slo.*.rps,slo.*.availability,batcher.depth,"
+    "dev.device_ms_ps"
+)
+
+
+def _history_cursor(base: str) -> int | None:
+    """The metrics-history base-seq cursor NOW (None when the node
+    predates /debug/history or runs with the plane disabled)."""
+    snap = _fetch_json(base, "/debug/history?limit=0")
+    if not snap or "nextSeq" not in snap:
+        return None
+    return snap["nextSeq"]
+
+
+def _history_stage_delta(base: str, since: int | None) -> dict | None:
+    """Summary stats (mean/max/last) over the series samples the
+    history plane recorded DURING one stage — the ?since= cursor makes
+    the window exactly the stage's own samples, and the gap-honest
+    ``truncated`` flag rides along so a stage that outran the base
+    ring says so instead of silently shrinking."""
+    if since is None:
+        return None
+    snap = _fetch_json(
+        base,
+        f"/debug/history?since={int(since)}"
+        f"&series={urllib.parse.quote(_HISTORY_STAGE_SERIES, safe='')}",
+    )
+    if not snap:
+        return None
+    out = {
+        "samples": snap.get("returned", 0),
+        "truncated": bool(snap.get("truncated")),
+        "series": {},
+    }
+    for name, pts in (snap.get("series") or {}).items():
+        vals = [v for _, v in pts if v is not None]
+        if not vals:
+            continue
+        out["series"][name] = {
+            "mean": round(sum(vals) / len(vals), 4),
+            "max": round(max(vals), 4),
+            "last": round(vals[-1], 4),
+        }
+    return out
+
+
 def _fetch_text(base: str, path: str) -> str:
     netloc = urllib.parse.urlsplit(base).netloc
     conn = http.client.HTTPConnection(netloc, timeout=_HTTP_TIMEOUT)
@@ -494,6 +543,7 @@ class LoadHarness:
             pl_before = _planner_counters(self.uris[0])
             dc_before = _devcost_counters(self.uris[0])
             qo_before = _qos_counters(self.uris[0])
+            hi_before = _history_cursor(self.uris[0])
             prev_cap: tuple | None = None
             if stage.device_budget is not None:
                 from pilosa_tpu.core import membudget
@@ -596,6 +646,9 @@ class LoadHarness:
                     "qos": _qos_delta(
                         qo_before, _qos_counters(self.uris[0])
                     ),
+                    "history": _history_stage_delta(
+                        self.uris[0], hi_before
+                    ),
                 }
             )
         wall = time.monotonic() - t_run0
@@ -623,6 +676,34 @@ class LoadHarness:
         devcosts = _fetch_json(self.uris[0], "/debug/devcosts")
         # end-of-run governor state: per-tenant stages, debt, transitions
         qos = _fetch_json(self.uris[0], "/debug/qos")
+        # end-of-run history plane: sampler/tier state, detector
+        # baselines, and the run's trend incidents (each bundle carries
+        # its own pre-incident series windows at /debug/incidents?id=)
+        history = None
+        hist_snap = _fetch_json(self.uris[0], "/debug/history?limit=0")
+        if hist_snap and "nextSeq" in hist_snap:
+            trend = []
+            for inc in (incidents or {}).get("incidents", []):
+                if (inc.get("trigger") or {}).get("type") != "trend":
+                    continue
+                # the bundle detail carries the attached series windows;
+                # embed their span (not the points — the full evidence
+                # stays at /debug/incidents?id=)
+                entry = dict(inc)
+                detail = _fetch_json(
+                    self.uris[0], f"/debug/incidents?id={inc['id']}"
+                )
+                series = (detail or {}).get("series") or {}
+                entry["preSeconds"] = series.get("preSeconds")
+                entry["seriesCount"] = len(series.get("series") or {})
+                trend.append(entry)
+            history = {
+                "samples": hist_snap.get("seq"),
+                "cadence": hist_snap.get("cadence"),
+                "tiers": hist_snap.get("tiers"),
+                "detectors": hist_snap.get("detectors"),
+                "trendIncidents": trend,
+            }
         return report_mod.build_report(
             config=self.config.to_dict(),
             stages=stage_meta,
@@ -640,6 +721,7 @@ class LoadHarness:
             planner=planner,
             devcosts=devcosts,
             qos=qos,
+            history=history,
         )
 
 

@@ -40,6 +40,7 @@ def build_report(
     planner: dict | None = None,
     devcosts: dict | None = None,
     qos: dict | None = None,
+    history: dict | None = None,
 ) -> dict:
     """Aggregate worker records + the server's SLO snapshot into the
     report dict.  ``records`` rows are (op_class, open_loop_latency_s,
@@ -159,6 +160,12 @@ def build_report(
         # admission"): per-tenant stage/debt/shed counters plus the
         # pressure-ladder transition journal observed during the run
         "qos": qos,
+        # end-of-run metrics-history plane (docs/observability.md
+        # "Metrics history & trend incidents"): sampler/tier state,
+        # trend-detector baselines, and the run's `trend` incidents;
+        # per-stage entries carry windowed series stats (mean/max/last
+        # over exactly the samples recorded while each stage ran)
+        "history": history,
         "verdicts": verdicts,
         "pass": overall,
     }

@@ -61,6 +61,10 @@ class FlightRecorder:
         self.incident_segments = max(1, int(incident_segments))
         self.incident_traces = max(1, int(incident_traces))
         self.spike_504 = max(1, int(spike_504))
+        # optional hook (obs/history.py): callable(trigger) -> dict of
+        # series windows frozen into the bundle, so an incident carries
+        # its own recent history instead of just the moment of the edge
+        self.series_provider = None
         self._lock = threading.Lock()
         self._segments: list[dict] = []
         self._incidents: list[dict] = []
@@ -236,6 +240,14 @@ class FlightRecorder:
             "traces": kept,
             "slowQueries": slow,
         }
+        prov = self.series_provider
+        if prov is not None:
+            try:
+                series = prov(trigger)
+                if series:
+                    bundle["series"] = series
+            except Exception:  # graftlint: disable=exception-hygiene -- history attachment is best-effort
+                pass
         if slo_snap is not None:
             bundle["slo"] = {
                 name: {
@@ -283,7 +295,7 @@ class FlightRecorder:
         with self._lock:
             incidents = [
                 {k: v for k, v in b.items()
-                 if k not in ("segments", "traces", "slowQueries")}
+                 if k not in ("segments", "traces", "slowQueries", "series")}
                 for b in reversed(self._incidents)
             ]
             return {

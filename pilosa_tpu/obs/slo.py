@@ -250,21 +250,45 @@ class _Ring:
         lo = int((now - window) / self.slot_seconds) + 1
         hi = int(now / self.slot_seconds)
         total = errors = 0
-        for slot in self.slots:
-            if lo <= slot[0] <= hi:
-                total += slot[1]
-                errors += slot[2]
+        slots = self.slots
+        n = len(slots)
+        if hi - lo + 1 < n:
+            # walk only the slot indices the window can cover — a
+            # short window over a long-lived ring (e.g. the 5m burn
+            # window over the 3d ring) is a tiny fraction of it
+            for idx in range(lo, hi + 1):
+                slot = slots[idx % n]
+                if slot[0] == idx:
+                    total += slot[1]
+                    errors += slot[2]
+        else:
+            for slot in slots:
+                if lo <= slot[0] <= hi:
+                    total += slot[1]
+                    errors += slot[2]
         return total, errors
 
     def merged_buckets(self, now: float, window: float) -> list[int]:
         lo = int((now - window) / self.slot_seconds) + 1
         hi = int(now / self.slot_seconds)
         out = [0] * _N_BUCKETS
-        for slot in self.slots:
-            if lo <= slot[0] <= hi and slot[3] is not None:
-                counts = slot[3]
-                for i in range(_N_BUCKETS):
-                    out[i] += counts[i]
+        slots = self.slots
+        n = len(slots)
+        if hi - lo + 1 < n:
+            candidates = [
+                slot
+                for idx in range(lo, hi + 1)
+                for slot in (slots[idx % n],)
+                if slot[0] == idx and slot[3] is not None
+            ]
+        else:
+            candidates = [
+                s for s in slots if lo <= s[0] <= hi and s[3] is not None
+            ]
+        for slot in candidates:
+            counts = slot[3]
+            for i in range(_N_BUCKETS):
+                out[i] += counts[i]
         return out
 
 
@@ -414,6 +438,42 @@ class SLOTracker:
     def _class_names(self) -> list[str]:
         names = set(self.objectives) | set(self._classes)
         return sorted(names)
+
+    def series_sample(self) -> dict:
+        """Cheap per-tick sample for the metrics-history ring
+        (obs/history.py): active classes only, the latency window
+        only.
+
+        ``snapshot()`` walks every objective class across every burn
+        window — exposition-grade work, wrong for a ~1 s sampler
+        cadence.  This touches only classes that have observed traffic
+        and only short-window slots, so its cost tracks live
+        cardinality, not objective/burn-rule configuration."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        with self._lock:
+            for name, st in self._classes.items():
+                obj = self.objectives.get(name)
+                total, errors = st.ring.sum_window(
+                    now, self.latency_window
+                )
+                merged = st.ring.merged_buckets(now, self.latency_window)
+                p50 = _quantile(merged, 0.50)
+                p99 = _quantile(merged, 0.99)
+                ratio = errors / total if total else 0.0
+                d = {
+                    # lifetime counters: the sampler turns these into
+                    # per-second rates by differencing ticks
+                    "total": st.total,
+                    "errors": st.errors,
+                    "availability": 1.0 - ratio,
+                    "p50Ms": p50 * 1e3 if p50 is not None else None,
+                    "p99Ms": p99 * 1e3 if p99 is not None else None,
+                }
+                if obj is not None:
+                    d["burnRate"] = ratio / (1.0 - obj.availability)
+                out[name] = d
+        return out
 
     def snapshot(self) -> dict:
         """Full live objective state — the /debug/slo payload."""

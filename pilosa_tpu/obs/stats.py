@@ -314,13 +314,26 @@ def _prom_name(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _prom_escape(value: str) -> str:
+    """Escape a label VALUE per the Prometheus text exposition spec:
+    backslash, double-quote, and line-feed.  Tenant/index names are
+    user-controlled, so a hostile ``evil"} 1`` tenant must not be able
+    to forge metric lines or break strict scrapers."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(tags: tuple[str, ...]) -> str:
     if not tags:
         return ""
     parts = []
     for t in tags:
         k, _, v = t.partition(":")
-        parts.append(f'{_prom_name(k)}="{v}"')
+        parts.append(f'{_prom_name(k)}="{_prom_escape(v)}"')
     return "{" + ",".join(parts) + "}"
 
 
@@ -329,9 +342,54 @@ def _prom_le_labels(tags: tuple[str, ...], bound) -> str:
     parts = []
     for t in tags:
         k, _, v = t.partition(":")
-        parts.append(f'{_prom_name(k)}="{v}"')
+        parts.append(f'{_prom_name(k)}="{_prom_escape(v)}"')
     parts.append(f'le="{bound}"')
     return "{" + ",".join(parts) + "}"
+
+
+# -- metric descriptions (# HELP) -------------------------------------------
+#
+# Registry keyed by the EXPOSED metric name (after the pilosa_ prefix
+# and name mangling).  prometheus_text emits "# HELP" only for metrics
+# registered here, immediately before the "# TYPE" line, so unregistered
+# families keep byte-identical output.
+_HELP: dict[str, str] = {}
+_HELP_LOCK = threading.Lock()
+
+
+def describe(name: str, text: str) -> None:
+    """Register a one-line description for an exposed metric family
+    (e.g. ``describe("pilosa_set_bit", "bits set via PQL Set()")``)."""
+    with _HELP_LOCK:
+        _HELP[name] = str(text)
+
+
+def _help_escape(text: str) -> str:
+    # HELP text escapes backslash and line-feed only (quotes are legal)
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+describe("pilosa_set_bit", "bits set via PQL Set() writes")
+describe("pilosa_clear_bit", "bits cleared via PQL Clear() writes")
+describe("pilosa_query_durationSeconds",
+         "end-to-end PQL query latency through the executor")
+describe("pilosa_http_request_durationSeconds",
+         "HTTP request latency by route")
+describe("pilosa_http_deadline_exceeded",
+         "requests that ran out of deadline budget (504)")
+describe("pilosa_serving_cache_hit",
+         "warm repeat reads answered from the per-snapshot host cache")
+describe("pilosa_batcher_depth", "queued requests inside the micro-batcher")
+describe("pilosa_slo_error_budget_burn_rate",
+         "per-class SRE multi-window error-budget burn rate")
+describe("pilosa_dev_device_ms",
+         "measured on-device milliseconds from the device cost ledger")
+describe("pilosa_qos_shed_total",
+         "requests shed (429) by the cost-governed admission ladder")
+describe("pilosa_history_samples",
+         "metrics-history sampler ticks recorded into the ring TSDB")
+describe("pilosa_history_trend_incidents",
+         "trend-detector incidents fired through the flight recorder")
 
 
 def exemplar_suffix(
@@ -369,9 +427,15 @@ def prometheus_text(client: StatsClient, exemplar_filter=None) -> str:
         sets = {k: len(s) for k, s in client._sets.items()}
     seen: set[str] = set()
 
+    with _HELP_LOCK:
+        helps = dict(_HELP)
+
     def typ(name: str, t: str) -> None:
         if name not in seen:
             seen.add(name)
+            h = helps.get(name)
+            if h is not None:
+                out.append(f"# HELP {name} {_help_escape(h)}")
             out.append(f"# TYPE {name} {t}")
 
     for (name, tags), v in sorted(counters.items()):

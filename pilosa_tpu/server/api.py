@@ -134,6 +134,9 @@ class API:
         # Flight recorder + incident engine; NodeServer installs one
         # (obs/flightrec.py) — None means /debug/incidents serves empty.
         self.flightrec = None
+        # Ring-buffer metrics history + trend detectors; NodeServer
+        # installs one (obs/history.py) — None 404s /debug/history.
+        self.history = None
         # Bounded import worker pool: concurrency limit + backpressure
         # (reference api.go:66-96 importWorkerPoolSize default 2,
         # importWorker :313-348; both knobs configurable like the
@@ -1049,6 +1052,70 @@ class API:
     def jobs_snapshot(self, kind: str | None = None) -> dict:
         """Background-job records (active + bounded history)."""
         return self.holder.jobs.snapshot(kind)
+
+    def history_query(
+        self,
+        series=None,
+        since: int | None = None,
+        step: float | None = None,
+        limit: int | None = None,
+    ) -> dict | None:
+        """This node's local metrics-history window (obs/history.py);
+        None when the history plane is disabled."""
+        if self.history is None:
+            return None
+        return self.history.query(
+            series=series, since=since, step=step, limit=limit
+        )
+
+    def cluster_history(self, series=None, step: float | None = None) -> dict:
+        """Cluster-merged metrics history: fan out to every peer's local
+        rings and merge into ONE wall-clock-aligned timeline.  Alignment
+        comes from downsampling every node onto the same absolute
+        ``floor(t/step)*step`` grid (default: the local cadence), so
+        sampler phase differences between nodes disappear; attribution
+        is preserved by nesting points per node id under each series.
+        Unreachable peers are reported, not fatal — same contract as
+        cluster_events."""
+        step = float(step) if step is not None else (
+            self.history.cadence if self.history is not None else 1.0
+        )
+        local = self.history_query(series=series, step=step)
+        merged: dict[str, dict[str, list]] = {}
+        nodes: list[str] = []
+        unreachable = []
+
+        def fold(node_id: str, snap: dict | None) -> None:
+            if not snap:
+                return
+            nodes.append(node_id)
+            for name, pts in snap.get("series", {}).items():
+                merged.setdefault(name, {})[node_id] = pts
+
+        local_id = (
+            self.cluster.node_id if self.cluster is not None
+            else (local or {}).get("node", "")
+        )
+        fold(local_id, local)
+        if self.cluster is not None and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.node_id or not node.uri:
+                    continue
+                try:
+                    remote = self.client.debug_history(
+                        node.uri, series=series, step=step
+                    )
+                except Exception as e:
+                    unreachable.append({"node": node.id, "error": str(e)})
+                    continue
+                fold(remote.get("node") or node.id, remote)
+        return {
+            "cluster": True,
+            "step": step,
+            "nodes": nodes,
+            "series": merged,
+            "unreachable": unreachable,
+        }
 
     def slo_snapshot(self) -> dict:
         """Live per-op-class objective state (/debug/slo)."""

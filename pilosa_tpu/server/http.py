@@ -53,6 +53,37 @@ _SLO_ROUTE_CLASS = {
     "translate_ids": slo.OP_TRANSLATE,
 }
 
+# GET /debug discoverability index: every registered debug surface with
+# a one-line description (there are 10+ — nobody remembers them all).
+_DEBUG_ENDPOINTS: list[tuple[str, str]] = [
+    ("/debug/vars",
+     "expvar-style dump: counters, histograms, kernels, device budget"),
+    ("/debug/history",
+     "ring-buffer metrics history (?series=glob&since=&step=&cluster=true)"),
+    ("/debug/slo",
+     "per-op-class latency quantiles, error budgets, burn-rate alerts"),
+    ("/debug/qos",
+     "cost-governed admission: per-tenant queues, shed/degrade ladder"),
+    ("/debug/events",
+     "typed cluster event journal (?since= cursor, ?cluster=true merge)"),
+    ("/debug/traces",
+     "tail-sampled trace store (?id= spans, ?cluster=true assembly)"),
+    ("/debug/incidents",
+     "flight-recorder bundles: alert edges, 504 spikes, trend incidents"),
+    ("/debug/devcosts",
+     "device cost ledger: compiles/launches/transfers per site+tenant"),
+    ("/debug/slow-queries",
+     "bounded worst-offender log with full execution profiles"),
+    ("/debug/jobs",
+     "background-job progress: resize, anti-entropy, import drains"),
+    ("/debug/fragments",
+     "per-fragment container stats, op-log length, device residency"),
+    ("/debug/threads", "per-thread stack dump"),
+    ("/debug/profile",
+     "sampled CPU profile, flamegraph-collapsed (?seconds=&interval_ms=)"),
+    ("/debug/memory", "RSS, host mirror bytes, HBM budget, GC state"),
+]
+
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/$"), "root"),
     ("GET", re.compile(r"^/version$"), "version"),
@@ -61,7 +92,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
+    ("GET", re.compile(r"^/debug$"), "debug_index"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/history$"), "debug_history"),
     ("GET", re.compile(r"^/debug/slo$"), "debug_slo"),
     ("GET", re.compile(r"^/debug/qos$"), "debug_qos"),
     ("GET", re.compile(r"^/debug/slow-queries$"), "debug_slow_queries"),
@@ -435,6 +468,47 @@ class Handler(BaseHTTPRequestHandler):
         stages, shed/degraded counters and recent transitions
         (server/qos.py)."""
         self._send_json(200, self.api.qos_snapshot())
+
+    def r_debug_index(self):
+        """Debug-surface directory: every /debug/* endpoint with a
+        one-line description."""
+        self._send_json(200, {
+            "endpoints": [
+                {"path": p, "desc": d} for p, d in _DEBUG_ENDPOINTS
+            ],
+        })
+
+    def r_debug_history(self):
+        """Ring-buffer metrics history (obs/history.py): ?series= glob
+        filter, ?since= base-seq cursor (gap-honest `truncated` flag),
+        ?step= downsampling (tier selection + mean buckets),
+        ?cluster=true merges every peer's series into one wall-clock-
+        aligned timeline with per-node attribution."""
+        series = self.query_params.get("series", [None])[0]
+        try:
+            since_raw = self.query_params.get("since", [None])[0]
+            since = int(since_raw) if since_raw is not None else None
+            step_raw = self.query_params.get("step", [None])[0]
+            step = float(step_raw) if step_raw is not None else None
+            limit_raw = self.query_params.get("limit", [None])[0]
+            limit = int(limit_raw) if limit_raw is not None else None
+        except ValueError:
+            self._send_json(400, {"error": "bad since/step/limit"})
+            return
+        if self.query_params.get("cluster", ["false"])[0].lower() in (
+            "1", "true", "yes",
+        ):
+            self._send_json(
+                200, self.api.cluster_history(series=series, step=step)
+            )
+            return
+        snap = self.api.history_query(
+            series=series, since=since, step=step, limit=limit
+        )
+        if snap is None:
+            self._send_json(404, {"error": "metrics history disabled"})
+            return
+        self._send_json(200, snap)
 
     def r_debug_events(self):
         """Event journal past ?since=<seq> (gap-free cursor resume);

@@ -189,6 +189,14 @@ class NodeServer:
         flightrec_sample_interval: float = 0.025,
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
+        history_enabled: bool = True,
+        history_cadence: float = 1.0,
+        history_tiers: str = "300@1,240@15",
+        history_detectors: str = "latency,throughput,errors",
+        history_warmup: int = 10,
+        history_trips: int = 3,
+        history_latency_factor: float = 2.0,
+        history_latency_min_ms: float = 20.0,
         resize_watchdog_deadline: float = 15.0,
         mesh_dispatch: bool = True,
         device_budget: int | None = None,
@@ -375,6 +383,32 @@ class NodeServer:
                 spike_504=flightrec_spike_504,
             )
             self.api.flightrec = self.flightrec
+        # Retrospective metrics plane (obs/history.py): ring-buffer TSDB
+        # sampled at ~1 s cadence + EWMA trend detectors that promote
+        # sustained latency/throughput/error anomalies into `trend`
+        # flight-recorder incidents carrying their own series windows.
+        self.history = None
+        if history_enabled:
+            from pilosa_tpu.obs.history import MetricsHistory
+
+            self.history = MetricsHistory(
+                self.holder,
+                api=self.api,
+                node_id=self.node_id,
+                cadence=history_cadence,
+                tiers=history_tiers,
+                detectors=history_detectors,
+                warmup=history_warmup,
+                trips=history_trips,
+                latency_factor=history_latency_factor,
+                latency_min_ms=history_latency_min_ms,
+            )
+            self.api.history = self.history
+            if self.flightrec is not None:
+                self.history.flightrec = self.flightrec
+                self.flightrec.series_provider = (
+                    self.history.incident_series
+                )
         # Device cost ledger: recompile-storm detection (>= threshold new
         # XLA compiles inside the window, once past warmup) freezes a
         # flight-recorder incident bundle naming the storming sites and
@@ -478,6 +512,8 @@ class NodeServer:
         self.runtime_monitor.start()
         if self.flightrec is not None:
             self.flightrec.start()
+        if self.history is not None:
+            self.history.start()
         if self.resize_watchdog is not None:
             self.resize_watchdog.start()
         self.holder.events.record(
@@ -614,6 +650,8 @@ class NodeServer:
             self.api.dist.close()
         if self.resize_watchdog is not None:
             self.resize_watchdog.stop()
+        if self.history is not None:
+            self.history.stop()
         if self.flightrec is not None:
             self.flightrec.stop()
         self.runtime_monitor.stop()

@@ -43,6 +43,14 @@ class InProcessCluster:
         flightrec_sample_interval: float = 0.025,
         flightrec_segments: int = 60,
         flightrec_spike_504: int = 5,
+        history_enabled: bool = True,
+        history_cadence: float = 1.0,
+        history_tiers: str = "300@1,240@15",
+        history_detectors: str = "latency,throughput,errors",
+        history_warmup: int = 10,
+        history_trips: int = 3,
+        history_latency_factor: float = 2.0,
+        history_latency_min_ms: float = 20.0,
         mesh_dispatch: bool = True,
         rescache_entries: int = 512,
         rescache_promote_hits: int = 3,
@@ -81,6 +89,14 @@ class InProcessCluster:
             "flightrec_sample_interval": flightrec_sample_interval,
             "flightrec_segments": flightrec_segments,
             "flightrec_spike_504": flightrec_spike_504,
+            "history_enabled": history_enabled,
+            "history_cadence": history_cadence,
+            "history_tiers": history_tiers,
+            "history_detectors": history_detectors,
+            "history_warmup": history_warmup,
+            "history_trips": history_trips,
+            "history_latency_factor": history_latency_factor,
+            "history_latency_min_ms": history_latency_min_ms,
             "rescache_entries": rescache_entries,
             "rescache_promote_hits": rescache_promote_hits,
             "rescache_demote_deltas": rescache_demote_deltas,

@@ -1,0 +1,319 @@
+"""Retrospective metrics plane (obs/history.py): ring wraparound,
+decimation-tier handoff, gap-honest ``?since=`` cursors, downsampling
+against a numpy ground truth, and the EWMA trend detectors (frozen
+baseline, recovery hysteresis, one-incident-per-episode latch,
+flight-recorder attachment).
+
+Everything here drives :meth:`MetricsHistory.record` directly with
+synthetic samples and explicit wall clocks — no sampler thread, no
+HTTP — so ring arithmetic and detector state machines are exercised
+deterministically.  The live end-to-end surface (sampler cadence,
+/debug/history, cluster merge) is tools/smoke_history.py's job.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.obs.history import MetricsHistory, downsample, parse_tiers
+
+
+class _Holder:
+    slo = None
+    stats = None
+
+
+def mk(**kw):
+    kw.setdefault("tiers", "8@1,4@4")
+    return MetricsHistory(_Holder(), **kw)
+
+
+def fill(h, values, start=1000.0, dt=1.0, name="a"):
+    for i, v in enumerate(values):
+        h.record({name: v}, wall=start + i * dt)
+
+
+# -- tier spec ----------------------------------------------------------------
+
+
+def test_parse_tiers_sorts_by_decimation():
+    assert parse_tiers("240@15,300@1") == [(300, 1), (240, 15)]
+    assert parse_tiers("10@1") == [(10, 1)]
+
+
+def test_parse_tiers_rejects_missing_base():
+    with pytest.raises(ValueError):
+        parse_tiers("240@15")
+
+
+def test_parse_tiers_rejects_base_shorter_than_coarse_window():
+    # the base ring must retain one full decimation window, or the
+    # coarse fold would read slots the base tier already overwrote
+    with pytest.raises(ValueError):
+        parse_tiers("4@1,10@15")
+
+
+def test_parse_tiers_rejects_empty():
+    with pytest.raises(ValueError):
+        parse_tiers("")
+
+
+# -- ring wraparound ----------------------------------------------------------
+
+
+def test_wraparound_keeps_newest_and_advances_first_seq():
+    h = mk(tiers="8@1", detectors="")
+    fill(h, range(20))
+    q = h.query()
+    assert q["nextSeq"] == 20
+    assert q["firstSeq"] == 12
+    assert q["returned"] == 8
+    assert [v for _, v in q["series"]["a"]] == list(range(12, 20))
+    assert [t for t, _ in q["series"]["a"]] == [
+        1000.0 + i for i in range(12, 20)
+    ]
+
+
+def test_since_cursor_resumes_without_overlap():
+    h = mk(tiers="8@1", detectors="")
+    fill(h, range(6))
+    cur = h.query()["nextSeq"]
+    fill(h, [10, 11], start=1006.0)
+    q = h.query(since=cur)
+    assert q["truncated"] is False
+    assert [v for _, v in q["series"]["a"]] == [10, 11]
+    # at the head: nothing new, still not truncated
+    q = h.query(since=q["nextSeq"])
+    assert q["returned"] == 0 and q["truncated"] is False
+
+
+def test_since_behind_ring_is_truncated_not_silent():
+    h = mk(tiers="8@1", detectors="")
+    fill(h, range(20))
+    q = h.query(since=0)
+    assert q["truncated"] is True
+    assert [v for _, v in q["series"]["a"]] == list(range(12, 20))
+    # exactly at the retention edge: everything retained, no lie
+    q = h.query(since=12)
+    assert q["truncated"] is False and q["returned"] == 8
+
+
+def test_limit_keeps_newest():
+    h = mk(tiers="8@1", detectors="")
+    fill(h, range(6))
+    q = h.query(limit=2)
+    assert [v for _, v in q["series"]["a"]] == [4, 5]
+
+
+def test_series_glob_filter():
+    h = mk(tiers="8@1", detectors="")
+    h.record({"slo.read.p99_ms": 1.0, "batcher.depth": 2.0}, wall=1000.0)
+    q = h.query(series="slo.*")
+    assert set(q["series"]) == {"slo.read.p99_ms"}
+    q = h.query(series=["slo.*", "batcher.*"])
+    assert set(q["series"]) == {"slo.read.p99_ms", "batcher.depth"}
+
+
+# -- decimation handoff -------------------------------------------------------
+
+
+def test_decimation_folds_means_into_coarse_tier():
+    h = mk(tiers="8@1,4@4", detectors="")
+    fill(h, range(16))
+    q = h.query(step=4.0)
+    assert q["tierStep"] == 4.0
+    assert [v for _, v in q["series"]["a"]] == [1.5, 5.5, 9.5, 13.5]
+    # base-unit seq bookkeeping survives the tier switch
+    assert q["nextSeq"] == 16
+    assert q["firstSeq"] == 0
+
+
+def test_decimation_handoff_is_gap_honest():
+    h = mk(tiers="8@1,4@4", detectors="")
+    fill(h, range(40))
+    q = h.query(step=4.0)
+    # coarse tier holds 10 windows, retains 4 -> firstSeq 24 base units
+    assert q["firstSeq"] == 24
+    assert q["nextSeq"] == 40
+    assert h.query(step=4.0, since=0)["truncated"] is True
+    assert h.query(step=4.0, since=24)["truncated"] is False
+    # a coarse cursor rounds UP to the next whole window: seq 25 sits
+    # inside the [24, 28) window, which a resume must not re-serve
+    q = h.query(step=4.0, since=25)
+    assert q["truncated"] is False
+    assert [v for _, v in q["series"]["a"]][0] == pytest.approx(29.5)
+
+
+def test_decimation_nanmean_skips_gaps():
+    h = mk(tiers="8@1,4@4", detectors="")
+    h.record({"a": 1.0, "b": 5.0}, wall=1000.0)
+    h.record({"a": 3.0}, wall=1001.0)
+    h.record({"a": 5.0}, wall=1002.0)
+    h.record({"a": 7.0}, wall=1003.0)
+    q = h.query(step=4.0)
+    assert [v for _, v in q["series"]["a"]] == [4.0]
+    # b was present in 1 of 4 base slots: its mean is that sample, not
+    # a NaN-poisoned garbage value
+    assert [v for _, v in q["series"]["b"]] == [5.0]
+
+
+def test_absent_series_is_a_gap_in_base_tier():
+    h = mk(tiers="8@1", detectors="")
+    h.record({"a": 1.0}, wall=1000.0)
+    h.record({"b": 2.0}, wall=1001.0)
+    q = h.query()
+    assert q["series"]["a"] == [[1000.0, 1.0], [1001.0, None]]
+    assert q["series"]["b"] == [[1000.0, None], [1001.0, 2.0]]
+
+
+# -- downsampling -------------------------------------------------------------
+
+
+def test_downsample_matches_numpy_ground_truth():
+    rng = np.random.default_rng(42)
+    times = np.sort(1_000_000.0 + rng.uniform(0, 100, size=200))
+    vals = rng.normal(50.0, 10.0, size=200)
+    pts = [[float(t), float(v)] for t, v in zip(times, vals)]
+    step = 7.0
+    out = downsample(pts, step)
+    buckets = np.floor(times / step) * step
+    for bt, bv in out:
+        mask = buckets == bt
+        assert mask.any(), bt
+        assert bv == pytest.approx(float(vals[mask].mean()), abs=1e-3)
+    assert len(out) == len(np.unique(buckets))
+    assert [bt for bt, _ in out] == sorted(bt for bt, _ in out)
+
+
+def test_downsample_gap_bucket_is_none():
+    pts = [[0.5, None], [1.5, None], [2.5, 4.0]]
+    assert downsample(pts, 2.0) == [[0.0, None], [2.0, 4.0]]
+
+
+def test_explicit_step_snaps_phase_onto_grid():
+    # equal to the tier step, an explicit ?step= must still align raw
+    # sampler-phase times onto floor(t/step)*step — that grid is what
+    # makes the cluster merge comparable across nodes
+    h = mk(tiers="8@1", detectors="")
+    fill(h, range(6), start=1000.3)
+    q = h.query(step=1.0)
+    assert all(t == int(t) for t, _ in q["series"]["a"]), q["series"]["a"]
+
+
+# -- trend detectors ----------------------------------------------------------
+
+
+class _Rec:
+    def __init__(self):
+        self.captured = []
+
+    def capture_incident(self, trigger):
+        self.captured.append(trigger)
+
+
+def det(kind, **kw):
+    kw.setdefault("tiers", "32@1,8@8")
+    kw.setdefault("detectors", kind)
+    kw.setdefault("warmup", 3)
+    kw.setdefault("trips", 2)
+    kw.setdefault("latency_min_ms", 10.0)
+    h = mk(**kw)
+    h.flightrec = _Rec()
+    return h
+
+
+def test_latency_regression_fires_once_per_episode():
+    h = det("latency")
+    fill(h, [10.0] * 5, name="slo.read.p99_ms")
+    fill(h, [100.0] * 6, start=1005.0, name="slo.read.p99_ms")
+    assert len(h.flightrec.captured) == 1
+    trig = h.flightrec.captured[0]
+    assert trig["detector"] == "latency-regression"
+    assert trig["series"] == "slo.read.p99_ms"
+    assert trig["class"] == "read"
+    assert trig["observed"] > trig["baseline"]
+    st = h.trend_state()
+    assert st["episodeActive"] is True
+    assert st["series"]["latency:slo.read.p99_ms"]["latched"] is True
+
+
+def test_baseline_frozen_for_whole_episode():
+    h = det("latency")
+    fill(h, [10.0] * 5, name="slo.read.p99_ms")
+    fill(h, [100.0] * 10, start=1005.0, name="slo.read.p99_ms")
+    base = h.trend_state()["series"]["latency:slo.read.p99_ms"]["baseline"]
+    assert base == pytest.approx(10.0)
+
+
+def test_recovery_needs_hysteresis_midpoint():
+    h = det("latency")
+    fill(h, [10.0] * 5, name="slo.read.p99_ms")
+    fill(h, [100.0] * 3, start=1005.0, name="slo.read.p99_ms")
+    assert len(h.flightrec.captured) == 1
+    # hovering under the latch line (2x baseline = 20) but above the
+    # recovery midpoint (baseline + min_ms/2 = 15): still the SAME
+    # episode — no unlatch, no second incident
+    fill(h, [18.0] * 6, start=1008.0, name="slo.read.p99_ms")
+    assert h.trend_state()["episodeActive"] is True
+    assert len(h.flightrec.captured) == 1
+    # a real recovery unlatches, and a fresh regression is a fresh
+    # episode -> second incident
+    fill(h, [10.0] * 3, start=1014.0, name="slo.read.p99_ms")
+    assert h.trend_state()["episodeActive"] is False
+    fill(h, [100.0] * 3, start=1017.0, name="slo.read.p99_ms")
+    assert len(h.flightrec.captured) == 2
+
+
+def test_episode_latch_spans_series():
+    h = det("latency")
+    for i in range(5):
+        h.record(
+            {"slo.read.p99_ms": 10.0, "slo.write.p99_ms": 10.0},
+            wall=1000.0 + i,
+        )
+    for i in range(6):
+        h.record(
+            {"slo.read.p99_ms": 100.0, "slo.write.p99_ms": 100.0},
+            wall=1005.0 + i,
+        )
+    # both series latched, but they share ONE episode -> ONE incident
+    st = h.trend_state()["series"]
+    assert st["latency:slo.read.p99_ms"]["latched"] is True
+    assert st["latency:slo.write.p99_ms"]["latched"] is True
+    assert len(h.flightrec.captured) == 1
+
+
+def test_throughput_collapse_idle_is_not_collapse():
+    h = det("throughput")
+    fill(h, [20.0] * 5, name="slo.read.rps")
+    fill(h, [0.0] * 6, start=1005.0, name="slo.read.rps")
+    assert h.flightrec.captured == []
+    # a genuine collapse (nonzero but < collapse_frac * baseline) fires
+    fill(h, [1.0] * 2, start=1011.0, name="slo.read.rps")
+    assert len(h.flightrec.captured) == 1
+    assert h.flightrec.captured[0]["detector"] == "throughput-collapse"
+
+
+def test_error_acceleration_fires():
+    h = det("errors")
+    fill(h, [0.1] * 5, name="slo.read.eps")
+    fill(h, [5.0] * 2, start=1005.0, name="slo.read.eps")
+    assert len(h.flightrec.captured) == 1
+    assert h.flightrec.captured[0]["detector"] == "error-acceleration"
+
+
+def test_warmup_gate_blocks_cold_fires():
+    h = det("latency", warmup=50)
+    fill(h, [10.0] * 5, name="slo.read.p99_ms")
+    fill(h, [100.0] * 10, start=1005.0, name="slo.read.p99_ms")
+    assert h.flightrec.captured == []
+
+
+def test_incident_series_attaches_window_and_preseconds():
+    h = det("latency")
+    fill(h, [10.0] * 5, name="slo.read.p99_ms")
+    fill(h, [100.0] * 3, start=1005.0, name="slo.read.p99_ms")
+    trig = h.flightrec.captured[0]
+    out = h.incident_series(trig)
+    assert "slo.read.p99_ms" in out["series"]
+    assert out["preSeconds"] > 0
+    assert "coarse" in out  # two tiers configured -> coarse window too

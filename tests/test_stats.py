@@ -209,3 +209,60 @@ def test_histogram_buckets_resolve_sub_millisecond():
     # 0.25 ms edge, separated from the 0.9 ms one
     assert buckets["0.0001"] == 1
     assert buckets["0.001"] == 2
+
+
+def test_prometheus_label_values_escaped_hostile_tenant():
+    # a hostile tenant name must not be able to forge metric lines or
+    # break strict exposition parsers
+    s = MemStatsClient()
+    s.with_tags('tenant:evil"} 1\nforged_metric 9').count("shed", 2)
+    s.with_tags("tenant:back\\slash").count("shed")
+    text = prometheus_text(s)
+    assert (
+        'pilosa_shed{tenant="evil\\"} 1\\nforged_metric 9"} 2' in text
+    ), text
+    assert 'pilosa_shed{tenant="back\\\\slash"} 1' in text
+    # no forged line escaped into the exposition
+    assert not any(
+        line.startswith("forged_metric") for line in text.splitlines()
+    )
+    # every payload line stays "name{labels} value" shaped
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert line.rsplit(" ", 1)[1] != "", line
+
+
+def test_prometheus_le_labels_escape_and_order():
+    s = MemStatsClient()
+    s.with_tags('tenant:q"ote').timing("op", 0.002)
+    text = prometheus_text(s)
+    bucket_lines = [
+        l for l in text.splitlines()
+        if l.startswith("pilosa_op_seconds_bucket")
+    ]
+    assert bucket_lines, text
+    assert all('tenant="q\\"ote"' in l for l in bucket_lines)
+    assert all('le="' in l for l in bucket_lines)
+
+
+def test_prometheus_help_precedes_type_for_registered_families():
+    from pilosa_tpu.obs.stats import describe
+
+    s = MemStatsClient()
+    s.count("set_bit", 1)
+    s.count("some_unregistered_counter", 1)
+    text = prometheus_text(s)
+    lines = text.splitlines()
+    i = lines.index("# TYPE pilosa_set_bit counter")
+    assert lines[i - 1].startswith("# HELP pilosa_set_bit "), lines[i - 1]
+    # unregistered families stay byte-identical: TYPE but no HELP
+    j = lines.index("# TYPE pilosa_some_unregistered_counter counter")
+    assert not lines[j - 1].startswith(
+        "# HELP pilosa_some_unregistered_counter"
+    )
+    # registration is live and HELP text is newline-escaped
+    describe("pilosa_some_unregistered_counter", "now\ndocumented")
+    text = prometheus_text(s)
+    assert (
+        "# HELP pilosa_some_unregistered_counter now\\ndocumented" in text
+    )

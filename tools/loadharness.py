@@ -203,6 +203,53 @@ def resize_hook(cluster, settle: float = 0.4) -> None:
     cluster.remove_node(cluster.nodes.index(node))
 
 
+def trend_stages(
+    pre_seconds: float, rate: float, workers: int
+) -> list[StageSpec]:
+    """A DEDICATED steady-state sequence for the trend-incident
+    scenario.  The default stages are deliberately bursty — overload
+    doublings, stage-to-stage mix shifts — which trip the trend
+    detectors organically (a read-heavy stage collapses write rps; the
+    overload stage regresses p99) and drown the injected fault.  Here
+    every stage runs the SAME default mix at the SAME rate, so the
+    ``slow`` fault ``trend_hook`` injects mid-run is the only anomaly
+    in the whole timeline: steady traffic during which the hook first
+    lets the metrics history accumulate >= ``pre_seconds`` of
+    pre-incident window, then slows every coordinator fan-out leg so
+    per-class p99 genuinely regresses.  The EWMA detectors
+    (obs/history.py) must fire EXACTLY ONE ``trend`` incident for the
+    episode, whose bundle carries the pre-incident series."""
+    return [
+        StageSpec("settle", 6.0, rate, workers, None),
+        StageSpec("trend", pre_seconds + 25.0, rate, workers, None),
+    ]
+
+
+def trend_hook(
+    cluster, pre_seconds: float = 60.0, delay: float = 0.2,
+    poll: float = 0.5,
+) -> None:
+    """Run concurrently with the trend stage's traffic: wait until the
+    coordinator's history spans >= ``pre_seconds`` of wall clock (the
+    acceptance bar for the incident bundle's pre-incident evidence),
+    then slow every coordinator->peer fan-out leg.  Requires >= 2 nodes
+    and the HTTP fan-out plane (mesh dispatch off) so the fault
+    registry sits on the slowed path."""
+    hist = getattr(cluster.nodes[0], "history", None)
+    give_up = time.monotonic() + pre_seconds + 30.0
+    while hist is not None and time.monotonic() < give_up:
+        q = hist.query(series="slo.*.p99_ms")
+        span = max(
+            (pts[-1][0] - pts[0][0]
+             for pts in q["series"].values() if len(pts) >= 2),
+            default=0.0,
+        )
+        if span >= pre_seconds:
+            break
+        time.sleep(poll)
+    cluster.inject_fault("slow", node=1, delay=delay)
+
+
 def parse_fault(spec: str) -> dict:
     """``kind[,k=v...]`` -> inject_fault kwargs, e.g.
     ``slow,node=1,delay=0.05,p=0.5``."""
@@ -242,6 +289,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="append a resize stage: add a node mid-zipfian"
                          " traffic, then remove one (online per-fragment"
                          " migration under load)")
+    ap.add_argument("--trend", action="store_true",
+                    help="run the DEDICATED trend scenario (replaces the"
+                         " default stages): steady traffic accumulates the"
+                         " required pre-incident history, then the"
+                         " coordinator's fan-out legs are slowed so the"
+                         " EWMA detectors fire exactly one `trend`"
+                         " incident (forces >= 2 nodes and the HTTP"
+                         " fan-out plane)")
+    ap.add_argument("--trend-pre-seconds", type=float, default=60.0,
+                    help="pre-incident series window the trend incident"
+                         " bundle must carry (wall seconds)")
     ap.add_argument("--print-sequence", action="store_true",
                     help="print the deterministic op sequence as JSON lines"
                          " and exit (no cluster, no load)")
@@ -258,6 +316,20 @@ def main(argv: list[str] | None = None) -> int:
         quarter = max(1.5, args.duration / 4.0)
         stages.append(resize_stage(quarter, args.rate, args.workers))
         stage_hooks["resize"] = resize_hook
+    if args.trend:
+        if args.resize:
+            ap.error("--trend runs a dedicated steady-state sequence; "
+                     "combine it with --resize in separate runs")
+        # replace, don't append: the injected fault must be the only
+        # anomaly in the timeline (see trend_stages)
+        stages = trend_stages(
+            args.trend_pre_seconds, args.rate / 2.0, args.workers
+        )
+        stage_hooks["trend"] = (
+            lambda cluster: trend_hook(
+                cluster, pre_seconds=args.trend_pre_seconds
+            )
+        )
 
     if args.print_sequence:
         gen = WorkloadGenerator(config)
@@ -276,18 +348,26 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps({"stage": st.name, **op.to_wire()}))
         return 0
 
+    cluster_kwargs = {
+        "slo_burn_rules": SHORT_BURN_RULES,
+        "slo_slot_seconds": 1.0,
+        "slo_latency_window": 60.0,
+        "default_deadline": args.default_deadline,
+        "slo_objectives": OVERLOAD_OBJECTIVES,
+        **QOS_KNOBS,
+    }
+    nodes = args.nodes
+    if args.trend:
+        # the slow fault hooks the internal HTTP client, so the trend
+        # run needs a peer to slow and the HTTP fan-out plane active
+        nodes = max(nodes, 2)
+        cluster_kwargs["mesh_dispatch"] = False
+
     report = run_harness(
         config,
         stages,
-        nodes=args.nodes,
-        cluster_kwargs={
-            "slo_burn_rules": SHORT_BURN_RULES,
-            "slo_slot_seconds": 1.0,
-            "slo_latency_window": 60.0,
-            "default_deadline": args.default_deadline,
-            "slo_objectives": OVERLOAD_OBJECTIVES,
-            **QOS_KNOBS,
-        },
+        nodes=nodes,
+        cluster_kwargs=cluster_kwargs,
         faults=[parse_fault(f) for f in args.fault],
         preload_bits=args.preload_bits,
         stage_hooks=stage_hooks,
@@ -339,6 +419,15 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"  tenant {name:<14} n={t['count']:<6} shed={t['shed']:<5} "
             + (f"p99={p99:.2f}ms" if p99 is not None else "p99=n/a")
+        )
+    for inc in ((report.get("history") or {}).get("trendIncidents") or []):
+        trig = inc.get("trigger") or {}
+        pre = inc.get("preSeconds")
+        print(
+            f"  trend incident {inc.get('id', '?')} "
+            f"{trig.get('detector', '?')} on {trig.get('series', '?')} "
+            f"baseline={trig.get('baseline')} observed={trig.get('observed')}"
+            + (f" pre={pre:.0f}s" if pre is not None else "")
         )
     for name, v in report["verdicts"].items():
         print(f"  verdict {name:<14} {'PASS' if v['pass'] else 'FAIL'}")

@@ -1,0 +1,318 @@
+"""pilosatop — live terminal dashboard over ``GET /debug/history``.
+
+A ``top``-style operator view of one node (or, with ``--cluster``, the
+coordinator-merged cluster timeline): per-op-class SLO rows (p50/p99,
+availability, burn, rps) with unicode sparklines of the recent window,
+batcher depth, device-cost rates, per-tenant QoS admission, and the
+trend-detector state (baselines, latched episodes, recent ``trend``
+incidents).
+
+Pure stdlib: plain-ANSI full-screen redraw by default (works in any
+terminal and over ssh), ``--curses`` for flicker-free updates where
+available.  Usage::
+
+    python -m tools.pilosatop --host 127.0.0.1:10101 [--interval 1.0]
+        [--series 'slo.*'] [--window 120] [--cluster] [--curses]
+
+Reads are resumable ``?since=`` pulls against the ring TSDB, so the
+dashboard costs the node one bounded slice per refresh, not a full
+window."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RED = "\x1b[31m"
+_GREEN = "\x1b[32m"
+_YELLOW = "\x1b[33m"
+_RESET = "\x1b[0m"
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fetch(base: str, path: str, timeout: float = 5.0) -> dict | None:
+    url = f"http://{base}{path}" if "://" not in base else f"{base}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except Exception:  # graftlint: disable=exception-hygiene -- a dashboard must survive a restarting node
+        return None
+
+
+def sparkline(points: list, width: int = 24) -> str:
+    """Unicode sparkline of the last ``width`` values ([[t, v], ...];
+    None gaps render as spaces)."""
+    vals = [v for _, v in points[-width:]]
+    present = [v for v in vals if v is not None]
+    if not present:
+        return " " * min(width, len(vals))
+    lo, hi = min(present), max(present)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK[1])
+        else:
+            idx = 1 + int((v - lo) / span * (len(_SPARK) - 2))
+            out.append(_SPARK[min(idx, len(_SPARK) - 1)])
+    return "".join(out)
+
+
+def _last(points: list):
+    for _, v in reversed(points):
+        if v is not None:
+            return v
+    return None
+
+
+def _series_map(snap: dict, cluster: bool) -> dict[str, list]:
+    """name -> points; cluster payloads nest per node, so merge by
+    arrival order per bucket (points are already grid-aligned)."""
+    out: dict[str, list] = {}
+    for name, val in (snap.get("series") or {}).items():
+        if not cluster:
+            out[name] = val
+            continue
+        merged: dict[float, list] = {}
+        for pts in val.values():
+            for t, v in pts:
+                if v is not None:
+                    merged.setdefault(t, []).append(v)
+        out[name] = [
+            [t, sum(vs) / len(vs)] for t, vs in sorted(merged.items())
+        ]
+    return out
+
+
+def _fmt(v, nd=1, unit="") -> str:
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}{unit}"
+
+
+def render(
+    snap: dict, incidents: dict | None, host: str, cluster: bool,
+    color: bool = True,
+) -> str:
+    def c(code: str, s: str) -> str:
+        return f"{code}{s}{_RESET}" if color else s
+
+    series = _series_map(snap, cluster)
+    lines = []
+    nodes = snap.get("nodes")
+    where = (
+        f"{host} · {len(nodes)} nodes" if cluster and nodes else host
+    )
+    lines.append(
+        c(_BOLD, f"pilosatop · {where} · "
+                 f"{time.strftime('%H:%M:%S')}")
+    )
+    classes = sorted({
+        name.split(".", 1)[1].rsplit(".", 1)[0]
+        for name in series if name.startswith("slo.")
+    })
+    if classes:
+        lines.append(c(
+            _BOLD,
+            f"{'class':<22} {'p50ms':>8} {'p99ms':>8} {'avail':>7} "
+            f"{'burn':>6} {'rps':>7}  p99 trend",
+        ))
+    for cls in classes:
+        p50 = _last(series.get(f"slo.{cls}.p50_ms", []))
+        p99pts = series.get(f"slo.{cls}.p99_ms", [])
+        p99 = _last(p99pts)
+        avail = _last(series.get(f"slo.{cls}.availability", []))
+        burn = _last(series.get(f"slo.{cls}.burn", []))
+        rps = _last(series.get(f"slo.{cls}.rps", []))
+        av = _fmt(avail, 4)
+        if avail is not None and color:
+            av = c(_GREEN if avail >= 0.999 else _RED, av)
+        lines.append(
+            f"{cls:<22} {_fmt(p50, 2):>8} {_fmt(p99, 2):>8} {av:>7} "
+            f"{_fmt(burn, 2):>6} {_fmt(rps, 1):>7}  "
+            f"{sparkline(p99pts)}"
+        )
+    extras = [
+        ("batcher depth", "batcher.depth", 1),
+        ("device ms/s", "dev.device_ms_ps", 1),
+        ("compiles/s", "dev.compiles_ps", 2),
+        ("ingest rows/s", "ingest.decoded_ps", 0),
+        ("residency hit/s", "res.hits_ps", 1),
+    ]
+    rows = [
+        (label, series[key], nd)
+        for label, key, nd in extras if key in series
+    ]
+    if rows:
+        lines.append("")
+        for label, pts, nd in rows:
+            lines.append(
+                f"{label:<22} {_fmt(_last(pts), nd):>8}  {sparkline(pts)}"
+            )
+    tenants = sorted({
+        name.split(".", 1)[1].rsplit(".", 1)[0]
+        for name in series
+        if name.startswith("qos.") and name.endswith(".admitted_ps")
+    })
+    if tenants:
+        lines.append("")
+        lines.append(c(
+            _BOLD, f"{'tenant':<22} {'adm/s':>8} {'shed/s':>8} "
+                   f"{'debt ms':>9}",
+        ))
+        for t in tenants:
+            shed = _last(series.get(f"qos.{t}.shed_ps", []))
+            row = (
+                f"{t:<22} "
+                f"{_fmt(_last(series.get(f'qos.{t}.admitted_ps', [])), 1):>8} "
+                f"{_fmt(shed, 1):>8} "
+                f"{_fmt(_last(series.get(f'qos.{t}.debt_ms', [])), 1):>9}"
+            )
+            if shed and color:
+                row = c(_YELLOW, row)
+            lines.append(row)
+    det = snap.get("detectors") or {}
+    if det:
+        lines.append("")
+        state = "EPISODE ACTIVE" if det.get("episodeActive") else "quiet"
+        if color:
+            state = c(
+                _RED if det.get("episodeActive") else _GREEN, state
+            )
+        lines.append(
+            c(_BOLD, "trend detectors ")
+            + f"[{', '.join(det.get('enabled', []))}] {state}"
+        )
+        for f in (det.get("fired") or [])[-3:]:
+            lines.append(
+                c(_YELLOW,
+                  f"  fired {f.get('detector')} on {f.get('series')} "
+                  f"baseline={f.get('baseline')} "
+                  f"observed={f.get('observed')}")
+            )
+    if incidents:
+        trend = [
+            i for i in incidents.get("incidents", [])
+            if (i.get("trigger") or {}).get("type") == "trend"
+        ]
+        if trend:
+            lines.append("")
+            lines.append(c(_BOLD, "trend incidents"))
+            for i in trend[:3]:
+                trig = i.get("trigger") or {}
+                lines.append(
+                    f"  {i.get('id')} {trig.get('detector')} "
+                    f"{trig.get('series')} "
+                    f"({time.strftime('%H:%M:%S', time.localtime(i.get('at', 0)))})"
+                )
+    lines.append("")
+    lines.append(c(_DIM, "q/Ctrl-C to quit"))
+    return "\n".join(lines)
+
+
+def _pull(args) -> tuple[dict | None, dict | None]:
+    qs = [f"step={args.interval}"]
+    if args.series:
+        qs.append("series=" + urllib.parse.quote(args.series, safe=""))
+    if args.cluster:
+        qs.append("cluster=true")
+    if args.window:
+        qs.append(f"limit={int(args.window)}")
+    snap = _fetch(args.host, "/debug/history?" + "&".join(qs))
+    incidents = _fetch(args.host, "/debug/incidents")
+    return snap, incidents
+
+
+def _loop_ansi(args) -> int:
+    while True:
+        snap, incidents = _pull(args)
+        sys.stdout.write(_CLEAR)
+        if snap is None:
+            sys.stdout.write(
+                f"pilosatop: {args.host} unreachable or history "
+                f"disabled — retrying\n"
+            )
+        else:
+            sys.stdout.write(
+                render(snap, incidents, args.host, args.cluster) + "\n"
+            )
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+def _loop_curses(args) -> int:
+    import curses
+
+    def body(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        while True:
+            snap, incidents = _pull(args)
+            scr.erase()
+            text = (
+                render(snap, incidents, args.host, args.cluster,
+                       color=False)
+                if snap is not None
+                else f"pilosatop: {args.host} unreachable — retrying"
+            )
+            maxy, maxx = scr.getmaxyx()
+            for y, line in enumerate(text.split("\n")[: maxy - 1]):
+                scr.addnstr(y, 0, line, maxx - 1)
+            scr.refresh()
+            t_end = time.monotonic() + args.interval
+            while time.monotonic() < t_end:
+                ch = scr.getch()
+                if ch in (ord("q"), ord("Q")):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(body)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live terminal dashboard over /debug/history"
+    )
+    ap.add_argument("--host", default="127.0.0.1:10101",
+                    help="node host:port (any node can serve --cluster)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh + downsampling step (seconds)")
+    ap.add_argument("--series", default=None,
+                    help="series glob filter, e.g. 'slo.*,batcher.*'")
+    ap.add_argument("--window", type=int, default=120,
+                    help="samples per refresh (sparkline history)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="coordinator-merged cluster timeline")
+    ap.add_argument("--curses", action="store_true",
+                    help="curses renderer (default: plain ANSI redraw)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame to stdout and exit (no ANSI)")
+    args = ap.parse_args(argv)
+    if args.once:
+        snap, incidents = _pull(args)
+        if snap is None:
+            print(f"pilosatop: {args.host} unreachable or history disabled")
+            return 1
+        print(render(snap, incidents, args.host, args.cluster,
+                     color=False))
+        return 0
+    try:
+        if args.curses:
+            return _loop_curses(args)
+        return _loop_ansi(args)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""CI smoke for the retrospective metrics plane (obs/history.py).
+
+Boots a REAL 2-node in-process cluster with a fast history cadence and
+asserts, end to end over HTTP:
+
+* the ring TSDB accumulates: after a query burst, ``/debug/history``
+  serves ``slo.*`` series with an advancing ``nextSeq``, ``?since=``
+  cursors resume gap-honestly, and ``?step=`` downsamples;
+* ``GET /debug`` lists the registered debug endpoints (history and
+  incidents included);
+* a fault-injected latency regression — a ``slow`` network fault on the
+  coordinator's fan-out legs — makes the EWMA latency-regression
+  detector fire EXACTLY ONE ``trend`` incident for the episode, whose
+  bundle attaches the pre-incident series window;
+* ``?cluster=true`` merges every node's series into one wall-clock-
+  aligned timeline with per-node attribution preserved.
+
+Exit status 0 on success; any assertion/exception fails the CI step.
+Run as ``python -m tools.smoke_history``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+
+def _get(uri: str) -> bytes:
+    return urllib.request.urlopen(uri, timeout=10).read()
+
+
+def _post(uri: str, body: bytes, timeout: float = 10.0) -> bytes:
+    req = urllib.request.Request(
+        uri, data=body, headers={"Content-Type": "text/plain"},
+        method="POST",
+    )
+    return urllib.request.urlopen(req, timeout=timeout).read()
+
+
+# fast knobs: ~20 samples/s, tiny rings, short warmup — the whole smoke
+# runs in a few seconds while exercising the same code paths as the 1 s
+# production cadence
+CADENCE = 0.05
+CLUSTER_KNOBS = dict(
+    history_cadence=CADENCE,
+    history_tiers="200@1,50@10",
+    history_warmup=8,
+    history_trips=3,
+    history_latency_min_ms=30.0,
+    # HTTP fan-out plane: the slow fault hooks the internal client, and
+    # mesh dispatch would bypass it
+    mesh_dispatch=False,
+    slo_slot_seconds=0.5,
+    slo_latency_window=10.0,
+)
+
+
+def main() -> int:
+    from pilosa_tpu.testing.cluster import InProcessCluster
+
+    with InProcessCluster(2, **CLUSTER_KNOBS) as cluster:
+        base = cluster.nodes[0].uri
+        cluster.create_index("hsmoke")
+        cluster.create_field("hsmoke", "f")
+        # span 4 shards so the coordinator's Count genuinely fans out to
+        # the peer (the slow fault lives on the internal-client path);
+        # with 2 nodes the hash ring places shards on both
+        shard_width = cluster.nodes[0].api.holder.n_words * 32
+        writes = " ".join(
+            f"Set({s * shard_width + c}, f={r})"
+            for r in range(2)
+            for s in range(4)
+            for c in (1, 2)
+        )
+        _post(f"{base}/index/hsmoke/query", writes.encode(), timeout=120)
+
+        def burst(n: int, pause: float = 0.0) -> None:
+            for _ in range(n):
+                _post(
+                    f"{base}/index/hsmoke/query",
+                    b"Count(Intersect(Row(f=0), Row(f=1)))",
+                )
+                if pause:
+                    time.sleep(pause)
+
+        # -- /debug index: discoverability ------------------------------
+        idx = json.loads(_get(f"{base}/debug"))
+        paths = {e["path"] for e in idx["endpoints"]}
+        assert "/debug/history" in paths and "/debug/incidents" in paths, (
+            paths
+        )
+        assert all(e.get("desc") for e in idx["endpoints"]), idx
+
+        # -- series accumulate under a burst ----------------------------
+        burst(30, pause=0.01)
+        deadline = time.monotonic() + 15.0
+        snap = {}
+        while time.monotonic() < deadline:
+            snap = json.loads(_get(f"{base}/debug/history"))
+            slo_series = [
+                s for s in snap.get("series", {}) if s.startswith("slo.")
+            ]
+            if snap.get("nextSeq", 0) >= 20 and slo_series:
+                break
+            burst(5)
+            time.sleep(CADENCE)
+        assert snap.get("nextSeq", 0) >= 20, snap.get("nextSeq")
+        p99_names = [
+            s for s in snap["series"] if s.endswith(".p99_ms")
+        ]
+        assert p99_names, sorted(snap["series"])
+
+        # -- gap-honest cursors -----------------------------------------
+        # a cursor at the head resumes without rewinding (the sampler is
+        # live, so a few ticks may land between the two reads)
+        cur = snap["nextSeq"]
+        resumed = json.loads(_get(f"{base}/debug/history?since={cur}"))
+        assert resumed["truncated"] is False, resumed
+        assert resumed["nextSeq"] >= cur, (resumed["nextSeq"], cur)
+        burst(5)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            resumed = json.loads(_get(f"{base}/debug/history?since={cur}"))
+            if resumed["returned"] >= 1:
+                break
+            time.sleep(CADENCE * 4)
+        assert resumed["returned"] >= 1, resumed["returned"]
+        assert resumed["truncated"] is False, resumed
+        # a cursor behind the ring must say so, not silently skip
+        while True:
+            snap = json.loads(_get(f"{base}/debug/history?limit=0"))
+            if snap["firstSeq"] > 0:
+                break
+            burst(2)
+            time.sleep(CADENCE * 10)
+        stale = json.loads(_get(f"{base}/debug/history?since=0"))
+        assert stale["truncated"] is True, stale["firstSeq"]
+
+        # -- ?step= downsampling ----------------------------------------
+        full = json.loads(_get(f"{base}/debug/history"))
+        coarse = json.loads(
+            _get(f"{base}/debug/history?step={CADENCE * 10}")
+        )
+        name = p99_names[0]
+        if name in coarse["series"] and name in full["series"]:
+            assert len(coarse["series"][name]) < len(
+                full["series"][name]
+            ), (len(coarse["series"][name]), len(full["series"][name]))
+
+        # -- fault-injected latency regression => ONE trend incident ----
+        # baseline: fast queries (fan-out legs answer in ~ms), until the
+        # detector is warmed up for at least one class AND its EWMA has
+        # decayed past the first-compile latency spike (a baseline still
+        # chasing that spike would swallow the injected regression)
+        deadline = time.monotonic() + 45.0
+        warmed = []
+        det = {"series": {}}
+        while time.monotonic() < deadline:
+            burst(5)
+            time.sleep(CADENCE)
+            det = json.loads(_get(f"{base}/debug/history"))["detectors"]
+            warmed = [
+                k for k, st in det["series"].items()
+                if k.startswith("latency:")
+                and st["n"] >= 8
+                and st["baseline"] is not None
+                and st["baseline"] <= 150.0
+            ]
+            if warmed:
+                break
+        assert warmed, det["series"]
+
+        # regression: every coordinator->peer leg now stalls 1 s —
+        # far past 2x any warm baseline the loop above admits
+        cluster.inject_fault("slow", node=1, delay=1.0)
+        deadline = time.monotonic() + 30.0
+        trend = []
+        while time.monotonic() < deadline and not trend:
+            burst(3)
+            time.sleep(CADENCE)
+            incidents = json.loads(_get(f"{base}/debug/incidents"))
+            trend = [
+                i for i in incidents["incidents"]
+                if (i.get("trigger") or {}).get("type") == "trend"
+            ]
+        assert len(trend) == 1, trend
+        trig = trend[0]["trigger"]
+        assert trig["detector"] == "latency-regression", trig
+        assert trig["observed"] > trig["baseline"], trig
+
+        # keep the regression burning: the episode latch must hold ONE
+        # incident, not fire per tripping series
+        burst(6)
+        time.sleep(CADENCE * 10)
+        incidents = json.loads(_get(f"{base}/debug/incidents"))
+        trend = [
+            i for i in incidents["incidents"]
+            if (i.get("trigger") or {}).get("type") == "trend"
+        ]
+        assert len(trend) == 1, [i["trigger"] for i in trend]
+
+        # the bundle attaches the pre-incident series window
+        bundle = json.loads(
+            _get(f"{base}/debug/incidents?id={trend[0]['id']}")
+        )
+        series = bundle.get("series") or {}
+        assert series.get("series"), bundle.keys()
+        assert trig["series"] in series["series"], (
+            trig["series"], sorted(series["series"]),
+        )
+        assert series.get("preSeconds", 0) > 0, series.get("preSeconds")
+        cluster.clear_faults()
+
+        # -- cluster merge: wall-clock-aligned, per-node attribution ----
+        merged = json.loads(
+            _get(f"{base}/debug/history?cluster=true&step={CADENCE * 10}")
+        )
+        assert merged["cluster"] is True, merged
+        assert len(merged["nodes"]) == 2, merged["nodes"]
+        assert not merged["unreachable"], merged["unreachable"]
+        step = merged["step"]
+        per_node_names = set()
+        for sname, by_node in merged["series"].items():
+            for node_id, pts in by_node.items():
+                per_node_names.add(node_id)
+                for t, _v in pts:
+                    # every point sits on the shared wall-clock grid
+                    # (1e-3 tolerance: grid times are rounded to ms)
+                    assert abs(t / step - round(t / step)) < 1e-3, (
+                        sname, node_id, t, step,
+                    )
+        assert per_node_names == set(merged["nodes"]), (
+            per_node_names, merged["nodes"],
+        )
+        # both nodes contribute their own slo series (each sampled its
+        # own traffic: node0 served the burst, node1 the fan-out legs)
+        multi = [
+            s for s, by_node in merged["series"].items()
+            if len(by_node) == 2
+        ]
+        assert multi, {
+            s: sorted(b) for s, b in list(merged["series"].items())[:8]
+        }
+
+    print("history smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
