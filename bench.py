@@ -571,6 +571,96 @@ def _history_overhead_lane() -> dict:
     }
 
 
+def _blackbox_overhead_lane() -> dict:
+    """Black-box overhead lane (recorder-lane shape): the same served
+    query loop against two freshly booted DISK-BACKED nodes — one with
+    the crash-durable spool writer live (obs/blackbox.py) checkpointing
+    every 0.2s (25x the production 5s cadence, so the lane exercises
+    the writer rather than the gap between ticks) and one with the
+    black box off — interleaved blocks, best-block compare.  The
+    writer's self-accounting (checkpoints taken, seconds spent) rides
+    along so a regression is attributable.  Target: <= 5% qps."""
+    import http.client
+    import tempfile
+
+    from pilosa_tpu.server.node import NodeServer
+
+    def boot(blackbox: bool, data_dir: str):
+        # rescache off for the same reason as the recorder lane: a
+        # cache hit skips the execution whose planes the writer spools
+        srv = NodeServer(
+            port=0,
+            data_dir=data_dir,
+            blackbox_enabled=blackbox,
+            blackbox_interval=0.2,
+            rescache_entries=0,
+        )
+        srv.start()
+        api = srv.api
+        api.create_index("bb")
+        api.create_field("bb", "f")
+        rng = np.random.default_rng(23)
+        width = api.holder.n_words * 32
+        writes = [
+            f"Set({int(c)}, f={row})"
+            for row in range(4)
+            for c in rng.integers(0, width, size=150)
+        ]
+        api.query("bb", " ".join(writes))
+        conn = http.client.HTTPConnection(
+            srv.host, srv.server.port, timeout=60
+        )
+        body = b"Count(Intersect(Row(f=0), Row(f=1)))"
+
+        def once() -> None:
+            conn.request("POST", "/index/bb/query", body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"blackbox lane HTTP {resp.status}: {data[:120]!r}"
+                )
+
+        return srv, conn, once
+
+    with tempfile.TemporaryDirectory() as tmp_on, \
+            tempfile.TemporaryDirectory() as tmp_off:
+        srv_on, conn_on, once_on = boot(True, tmp_on)
+        srv_off, conn_off, once_off = boot(False, tmp_off)
+        try:
+            for once in (once_on, once_off):
+                for _ in range(50):
+                    once()
+            reps, best_on, best_off = 200, 0.0, 0.0
+            for _ in range(5):
+                for once, which in ((once_off, "off"), (once_on, "on")):
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        once()
+                    qps = reps / (time.perf_counter() - t0)
+                    if which == "on":
+                        best_on = max(best_on, qps)
+                    else:
+                        best_off = max(best_off, qps)
+            writer = (
+                srv_on.blackbox.stats()
+                if srv_on.blackbox is not None else None
+            )
+            conn_on.close()
+            conn_off.close()
+        finally:
+            srv_on.stop()
+            srv_off.stop()
+    return {
+        "qps_blackbox_on": round(best_on, 1),
+        "qps_blackbox_off": round(best_off, 1),
+        "overhead_frac": (
+            round(1.0 - best_on / best_off, 4) if best_off else None
+        ),
+        "writer": writer,
+    }
+
+
 def _mesh_dist_lane() -> dict:
     """Cluster-on-mesh lane: distributed Count/TopN/Range on an in-mesh
     8-way InProcessCluster — every owner's fragments are slices of the
@@ -1506,6 +1596,15 @@ def main() -> None:
     except Exception as e:
         print(f"warning: history overhead lane failed: {e}", file=sys.stderr)
 
+    # -- black-box overhead: served qps with the crash-durable spool
+    # writer on vs off at 25x cadence (the lane must never sink the
+    # bench)
+    blackbox_lane = None
+    try:
+        blackbox_lane = _blackbox_overhead_lane()
+    except Exception as e:
+        print(f"warning: blackbox overhead lane failed: {e}", file=sys.stderr)
+
     # -- cluster-on-mesh lane: distributed Count/TopN/Range answered as
     # one jit-sharded launch over an in-mesh 8-way cluster, vs the same
     # data on a single holder (the lane must never sink the bench)
@@ -2075,6 +2174,10 @@ def main() -> None:
         # metrics-history cost (obs/history.py sampler + trend
         # detectors at 2x production cadence): same <= 0.05 bar
         "history_overhead": history_lane,
+        # crash-durable black-box cost (obs/blackbox.py spool writer at
+        # 25x production cadence, disk-backed nodes): same <= 0.05 bar;
+        # "writer" carries the spool's own checkpoint self-accounting
+        "blackbox_overhead": blackbox_lane,
         # tiered-residency lane: oversubscribed_vs_resident >= 0.25 and
         # prefetch_useful_frac >= 0.5 are the working-set manager's bars
         # (docs/residency.md)

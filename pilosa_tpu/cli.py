@@ -41,6 +41,16 @@ DEFAULT_CONFIG = {
     "max-writes-per-request": 5000,
     "metric": {"service": "none", "poll-interval": 60, "diagnostics-sink": ""},
     "tracing": {"enabled": False},
+    # crash-durable diagnostics spool under <data-dir>/_blackbox/
+    # (obs/blackbox.py); postmortems served at GET /debug/postmortem
+    "blackbox": {
+        "enabled": True,
+        "interval": 5.0,
+        "max-segments": 64,
+        "max-bytes": 16 << 20,
+        "keep-postmortems": 4,
+        "history-window": 60.0,
+    },
 }
 
 
@@ -170,7 +180,30 @@ def cmd_server(args) -> int:
         import_workers=int(cfg.get("import", {}).get("workers", 2)),
         max_writes_per_request=int(cfg.get("max-writes-per-request", 5000)),
         import_queue_depth=int(cfg.get("import", {}).get("queue-depth", 16)),
+        blackbox_enabled=bool(cfg.get("blackbox", {}).get("enabled", True)),
+        blackbox_interval=float(cfg.get("blackbox", {}).get("interval", 5.0)),
+        blackbox_max_segments=int(
+            cfg.get("blackbox", {}).get("max-segments", 64)
+        ),
+        blackbox_max_bytes=int(
+            cfg.get("blackbox", {}).get("max-bytes", 16 << 20)
+        ),
+        blackbox_keep_postmortems=int(
+            cfg.get("blackbox", {}).get("keep-postmortems", 4)
+        ),
+        blackbox_history_window=float(
+            cfg.get("blackbox", {}).get("history-window", 60.0)
+        ),
     )
+    if node.postmortem is not None:
+        pm = node.postmortem
+        print(
+            f"previous life died dirty: postmortem {pm['id']} "
+            f"(crash loop {pm['crashLoop']}) at /debug/postmortem"
+        )
+    # SIGTERM drains the node and exits 0 — an orderly stop must never
+    # read as a crash on the next boot
+    node.install_signal_handlers()
     # tracing exporter + sampler (reference tracing config
     # server/config.go:139-145)
     trace_cfg = cfg.get("tracing", {})

@@ -9,6 +9,7 @@ the reference's protobuf codec.
 
 from __future__ import annotations
 
+import gzip
 import http.client
 import json
 import random
@@ -248,6 +249,10 @@ class _ConnPool:
                 conn.close()
             else:
                 self._checkin(key, conn)
+            if (resp.headers.get("Content-Encoding") or "").lower() == "gzip":
+                # transparent decode: callers asked for gzip on the wire
+                # (Accept-Encoding), not in their hands
+                data = gzip.decompress(data)
             return (
                 resp.status,
                 data,
@@ -361,6 +366,7 @@ class InternalClient:
         accept: str | None = None,
         idempotent: bool = True,
         retries: int | None = None,
+        gzip_ok: bool = False,
     ) -> tuple[bytes, str]:
         """(body, response content-type).
 
@@ -378,6 +384,10 @@ class InternalClient:
             headers["Content-Type"] = content_type
         if accept is not None:
             headers["Accept"] = accept
+        if gzip_ok:
+            # large debug snapshots (history/traces/postmortem) compress
+            # ~10x; the pool decodes transparently on the way back
+            headers["Accept-Encoding"] = "gzip"
         # Propagate the active trace across the node boundary (reference
         # tracing/opentracing.go:58-66 InjectHTTPHeaders).
         span = tracing.active_span()
@@ -443,12 +453,22 @@ class InternalClient:
         path: str,
         body: bytes | None = None,
         content_type: str = "application/json",
+        gzip_ok: bool = False,
     ) -> bytes:
-        return self._do_full(method, uri, path, body, content_type)[0]
+        return self._do_full(
+            method, uri, path, body, content_type, gzip_ok=gzip_ok
+        )[0]
 
-    def _json(self, method: str, uri: str, path: str, obj: Any = None) -> Any:
+    def _json(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        obj: Any = None,
+        gzip_ok: bool = False,
+    ) -> Any:
         body = None if obj is None else json.dumps(obj).encode()
-        out = self._do(method, uri, path, body)
+        out = self._do(method, uri, path, body, gzip_ok=gzip_ok)
         return json.loads(out) if out else None
 
     # -- queries (reference http/client.go QueryNode) -----------------------
@@ -662,13 +682,16 @@ class InternalClient:
 
     def debug_traces(self, uri: str, limit: int = 100) -> dict:
         """Pull a peer's kept-trace summaries (cluster trace list)."""
-        return self._json("GET", uri, f"/debug/traces?limit={int(limit)}")
+        return self._json(
+            "GET", uri, f"/debug/traces?limit={int(limit)}", gzip_ok=True
+        )
 
     def debug_trace_spans(self, uri: str, trace_id: str) -> dict:
         """Pull the spans a peer holds for one trace id (cluster trace
         assembly) — kept or merely recent on that node."""
         return self._json(
-            "GET", uri, f"/debug/traces?id={trace_id}&spans=true"
+            "GET", uri, f"/debug/traces?id={trace_id}&spans=true",
+            gzip_ok=True,
         )
 
     def debug_history(
@@ -693,7 +716,15 @@ class InternalClient:
         if limit is not None:
             params.append(f"limit={int(limit)}")
         qs = ("?" + "&".join(params)) if params else ""
-        return self._json("GET", uri, f"/debug/history{qs}")
+        return self._json("GET", uri, f"/debug/history{qs}", gzip_ok=True)
+
+    def debug_postmortem(self, uri: str, postmortem_id: str | None = None) -> dict:
+        """Pull a peer's sealed crash bundles (the coordinator's
+        ``?cluster=true`` merge fans out through here)."""
+        qs = f"?id={postmortem_id}" if postmortem_id else ""
+        return self._json(
+            "GET", uri, f"/debug/postmortem{qs}", gzip_ok=True
+        )
 
     def shards_max(self, uri: str) -> dict:
         """Per-index max shard seen by ``uri`` (reference
@@ -816,6 +847,9 @@ class NopInternalClient:
 
     def debug_trace_spans(self, uri, trace_id):
         return {"spans": []}
+
+    def debug_postmortem(self, uri, postmortem_id=None):
+        return {"postmortems": [], "latest": None, "postmortem": None}
 
     def breaker_states(self):
         return {}

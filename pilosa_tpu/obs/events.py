@@ -50,6 +50,8 @@ EVENT_SNAPSHOT = "snapshot"              # fragment op-log compaction
 EVENT_FAULT_INJECTED = "fault-injected"  # testing/faults.py rule fired
 EVENT_INCIDENT = "incident"              # flight recorder auto-capture
 EVENT_QOS = "qos-transition"             # pressure-ladder stage change
+EVENT_NODE_STOP = "node-stop"            # orderly shutdown began
+EVENT_NODE_CRASH = "node-crash-detected"  # previous life died dirty
 
 
 class EventJournal:

@@ -65,6 +65,11 @@ class FlightRecorder:
         # series windows frozen into the bundle, so an incident carries
         # its own recent history instead of just the moment of the edge
         self.series_provider = None
+        # optional hook (obs/blackbox.py): callable(bundle) invoked
+        # after a bundle freezes, so the black box can flush it to disk
+        # synchronously — an incident is when the process is likeliest
+        # to die next
+        self.on_incident = None
         self._lock = threading.Lock()
         self._segments: list[dict] = []
         self._incidents: list[dict] = []
@@ -274,6 +279,12 @@ class FlightRecorder:
             )
         except Exception:  # graftlint: disable=exception-hygiene -- journaling is best-effort
             pass
+        hook = self.on_incident
+        if hook is not None:
+            try:
+                hook(bundle)
+            except Exception:  # graftlint: disable=exception-hygiene -- durable-flush wiring must not fail the capture
+                pass
 
     def capture_incident(self, trigger: dict) -> None:
         """External incident trigger (the device ledger's recompile-storm
@@ -315,3 +326,10 @@ class FlightRecorder:
     def segments_snapshot(self, limit: int = 10) -> list[dict]:
         with self._lock:
             return list(self._segments[-limit:])
+
+    def incidents_full(self) -> list[dict]:
+        """Every retained bundle WITH bodies, oldest first — the black
+        box checkpoints these verbatim so a postmortem carries the same
+        evidence ``/debug/incidents?id=`` would have served live."""
+        with self._lock:
+            return [dict(b) for b in self._incidents]

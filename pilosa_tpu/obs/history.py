@@ -661,6 +661,24 @@ class MetricsHistory:
             ]
         return out
 
+    def blackbox_snapshot(self, window_s: float = 60.0) -> dict:
+        """Black-box checkpoint block: the trailing ``window_s`` of
+        every base-tier series plus detector state — enough that a
+        postmortem can answer "what did the last minute look like"
+        without the rings that died with the process."""
+        import math
+
+        limit = max(1, int(math.ceil(float(window_s) / self.cadence)))
+        q = self.query(limit=limit)
+        return {
+            "cadence": self.cadence,
+            "windowSeconds": float(window_s),
+            "series": q["series"],
+            "nextSeq": q["nextSeq"],
+            "detectors": q["detectors"],
+            "stats": self.stats(),
+        }
+
     def trend_state(self) -> dict:
         with self._lock:
             return {

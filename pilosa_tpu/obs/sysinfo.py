@@ -14,6 +14,28 @@ import platform
 import threading
 import time
 
+# fallback process start time where /proc is unavailable
+_IMPORT_TIME = time.time()
+
+
+def build_info_text(version: str) -> str:
+    """Prometheus ``build_info`` exposition block (the node_exporter
+    idiom: a constant 1-valued gauge whose labels carry the versions)."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = ""
+    py = platform.python_version()
+    return (
+        "# HELP pilosa_build_info build/version identity "
+        "(constant 1; labels carry the versions)\n"
+        "# TYPE pilosa_build_info gauge\n"
+        f'pilosa_build_info{{version="{version}",jax="{jax_version}",'
+        f'python="{py}"}} 1\n'
+    )
+
 
 class SystemInfo:
     """reference gopsutil/gopsutil.go systemInfo."""
@@ -80,6 +102,52 @@ class SystemInfo:
             return pages * os.sysconf("SC_PAGE_SIZE")
         except (OSError, ValueError):
             return 0
+
+    def process_start_time(self) -> float:
+        """Unix time this PROCESS started (the host ``uptime`` above is
+        boot time, not ours).  /proc/self/stat field 22 is start time
+        in clock ticks since boot; boot time is /proc/stat ``btime``.
+        Falls back to module-import time off Linux."""
+        try:
+            with open("/proc/self/stat") as f:
+                # comm (field 2) may contain spaces; split after the
+                # closing paren so field indices stay stable
+                rest = f.read().rsplit(")", 1)[1].split()
+            ticks = float(rest[19])  # field 22, 0-indexed after comm
+            with open("/proc/stat") as f:
+                for line in f:
+                    if line.startswith("btime "):
+                        btime = float(line.split()[1])
+                        break
+                else:
+                    return _IMPORT_TIME
+            return btime + ticks / os.sysconf("SC_CLK_TCK")
+        except (OSError, ValueError, IndexError):
+            return _IMPORT_TIME
+
+    def process_uptime(self) -> float:
+        """Seconds since this process started."""
+        return max(0.0, time.time() - self.process_start_time())
+
+    def process_block(self, version: str = "") -> dict:
+        """The ``process`` block for /debug/vars: this process's own
+        identity and age, distinct from the host report above."""
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:
+            jax_version = ""
+        return {
+            "pid": os.getpid(),
+            "version": version,
+            "python": platform.python_version(),
+            "jax": jax_version,
+            "startTime": self.process_start_time(),
+            "uptimeSeconds": round(self.process_uptime(), 3),
+            "rssBytes": self.process_rss(),
+            "threads": self.thread_count(),
+        }
 
     def devices(self) -> list[dict]:
         """Accelerator inventory (TPU-native extension)."""
@@ -181,6 +249,12 @@ class RuntimeMonitor:
         self.stats.gauge("memory_rss_bytes", self.info.process_rss())
         self.stats.gauge("threads", self.info.thread_count())
         self.stats.gauge("host_mem_free_bytes", self.info.mem_free())
+        self.stats.gauge(
+            "process_uptime_seconds", round(self.info.process_uptime(), 3)
+        )
+        self.stats.gauge(
+            "process_start_time_seconds", self.info.process_start_time()
+        )
         if self.gc_notifier is not None:
             self.stats.gauge("garbage_collections", self.gc_notifier.collections)
 

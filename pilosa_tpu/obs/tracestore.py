@@ -248,6 +248,14 @@ class TraceStore:
                 spans = list(self._recent.get(tid, ()))
         return [_span_dict(s, self.node_id) for s in spans]
 
+    def blackbox_snapshot(self, limit: int = 32) -> dict:
+        """Black-box checkpoint block: kept-trace summaries (no span
+        bodies — the spool is bounded) plus the store's counters."""
+        return {
+            "summaries": self.summaries(limit),
+            "snapshot": self.snapshot(),
+        }
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -137,6 +137,9 @@ class API:
         # Ring-buffer metrics history + trend detectors; NodeServer
         # installs one (obs/history.py) — None 404s /debug/history.
         self.history = None
+        # Crash-durable black box; NodeServer installs one when it has
+        # a data dir (obs/blackbox.py) — None 404s /debug/postmortem.
+        self.blackbox = None
         # Bounded import worker pool: concurrency limit + backpressure
         # (reference api.go:66-96 importWorkerPoolSize default 2,
         # importWorker :313-348; both knobs configurable like the
@@ -1214,6 +1217,54 @@ class API:
         if self.flightrec is None:
             return None
         return self.flightrec.incident_detail(incident_id)
+
+    # -- postmortem plane (black box, /debug/postmortem) --------------------
+
+    def postmortem_snapshot(self, postmortem_id: str | None = None) -> dict | None:
+        """Sealed crash bundles from this node's black box: the retained
+        summaries + the newest bundle in full, or one bundle by id.
+        None when the black box is disabled (no data dir) or the id is
+        unknown."""
+        if self.blackbox is None:
+            return None
+        if postmortem_id is not None:
+            return self.blackbox.postmortem_detail(postmortem_id)
+        return self.blackbox.postmortems()
+
+    def cluster_postmortems(self) -> dict:
+        """Every node's postmortem summaries, merged newest-first (same
+        fan-out contract as :meth:`cluster_events`: unreachable peers
+        are reported, not fatal).  Full bundles stay one ``?id=`` GET
+        away on the owning node — a cluster merge of multi-MB bundles
+        would be the wrong default."""
+        local = self.postmortem_snapshot() or {"postmortems": []}
+        merged = [
+            dict(s, node=s.get("node") or (
+                self.cluster.node_id if self.cluster is not None else ""
+            ))
+            for s in local.get("postmortems", [])
+        ]
+        nodes = 1
+        unreachable = []
+        if self.cluster is not None and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.node_id or not node.uri:
+                    continue
+                try:
+                    remote = self.client.debug_postmortem(node.uri)
+                except Exception as e:
+                    unreachable.append({"node": node.id, "error": str(e)})
+                    continue
+                nodes += 1
+                for s in remote.get("postmortems", []):
+                    merged.append(dict(s, node=s.get("node") or node.id))
+        merged.sort(key=lambda s: s.get("assembledAt") or 0.0, reverse=True)
+        return {
+            "cluster": True,
+            "postmortems": merged,
+            "nodes": nodes,
+            "unreachable": unreachable,
+        }
 
     def fragment_details(
         self, index: str | None = None, field: str | None = None

@@ -25,6 +25,7 @@ reference's long-query-time (handler.go:246-248).
 
 from __future__ import annotations
 
+import gzip as gzip_mod
 import json
 import logging
 import math
@@ -70,6 +71,8 @@ _DEBUG_ENDPOINTS: list[tuple[str, str]] = [
      "tail-sampled trace store (?id= spans, ?cluster=true assembly)"),
     ("/debug/incidents",
      "flight-recorder bundles: alert edges, 504 spikes, trend incidents"),
+    ("/debug/postmortem",
+     "sealed crash bundles from the black box (?id=, ?cluster=true merge)"),
     ("/debug/devcosts",
      "device cost ledger: compiles/launches/transfers per site+tenant"),
     ("/debug/slow-queries",
@@ -104,6 +107,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/events$"), "debug_events"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/incidents$"), "debug_incidents"),
+    ("GET", re.compile(r"^/debug/postmortem$"), "debug_postmortem"),
     ("GET", re.compile(r"^/debug/devcosts$"), "debug_devcosts"),
     ("GET", re.compile(r"^/debug/jobs$"), "debug_jobs"),
     ("GET", re.compile(r"^/debug/fragments$"), "debug_fragments"),
@@ -162,13 +166,25 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug(fmt, *args)
 
+    # gzip floor: tiny bodies cost more in header + CPU than they save
+    _GZIP_MIN_BYTES = 512
+
     def _send(
         self,
         code: int,
         body: bytes,
         content_type: str = "application/json",
         headers: dict | None = None,
+        gzip_ok: bool = False,
     ) -> None:
+        if (
+            gzip_ok
+            and len(body) >= self._GZIP_MIN_BYTES
+            and "gzip" in (self.headers.get("Accept-Encoding") or "")
+        ):
+            body = gzip_mod.compress(body, compresslevel=1)
+            headers = dict(headers or {})
+            headers["Content-Encoding"] = "gzip"
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -177,8 +193,17 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj, headers: dict | None = None) -> None:
-        self._send(code, (json.dumps(obj) + "\n").encode(), headers=headers)
+    def _send_json(
+        self,
+        code: int,
+        obj,
+        headers: dict | None = None,
+        gzip_ok: bool = False,
+    ) -> None:
+        self._send(
+            code, (json.dumps(obj) + "\n").encode(), headers=headers,
+            gzip_ok=gzip_ok,
+        )
 
     def _body(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
@@ -352,7 +377,9 @@ class Handler(BaseHTTPRequestHandler):
         registry (ops/kernels.kernel_stats) so it is visible even when
         the holder uses a NopStatsClient; both registries are rendered
         into the one scrape."""
+        from pilosa_tpu import __version__
         from pilosa_tpu.core import membudget, residency, translate
+        from pilosa_tpu.obs import sysinfo
         from pilosa_tpu.obs.stats import prometheus_text
         from pilosa_tpu.ops import kernels
 
@@ -360,6 +387,16 @@ class Handler(BaseHTTPRequestHandler):
         # counters, so no background poller is needed.
         stats = self.api.holder.stats
         if hasattr(stats, "gauge"):
+            # process self-metrics refresh at scrape time (satellites of
+            # the black-box plane: a restarted process is visible as a
+            # start-time jump + uptime reset without any poller race)
+            info = sysinfo.SystemInfo()
+            stats.gauge(
+                "process_uptime_seconds", round(info.process_uptime(), 3)
+            )
+            stats.gauge(
+                "process_start_time_seconds", info.process_start_time()
+            )
             dev = membudget.default_budget().snapshot()
             stats.gauge("device_used_bytes", dev["usedBytes"])
             stats.gauge("device_cap_bytes", dev["capBytes"] or 0)
@@ -389,11 +426,13 @@ class Handler(BaseHTTPRequestHandler):
             + prometheus_text(translate.translate_stats)
             + self.api.holder.slo.prometheus_text(exemplar_filter=filt)
             + devledger.prometheus_text()
+            + sysinfo.build_info_text(__version__)
         )
         self._send(
             200,
             text.encode(),
             content_type="text/plain; version=0.0.4",
+            gzip_ok=True,
         )
 
     def r_debug_vars(self):
@@ -455,6 +494,17 @@ class Handler(BaseHTTPRequestHandler):
             # cluster-on-mesh routing: the placement map plus recent
             # per-call partition decisions (mesh vs HTTP vs local)
             snap["dist"] = dist.snapshot()
+        from pilosa_tpu import __version__
+        from pilosa_tpu.obs import sysinfo
+
+        # process identity block: pid/version/uptime — distinct from the
+        # host report in /info (sysinfo.py reports host uptime there)
+        snap["process"] = sysinfo.SystemInfo().process_block(__version__)
+        blackbox = getattr(self.api, "blackbox", None)
+        if blackbox is not None:
+            # black-box writer self-accounting: checkpoint counts/cost,
+            # spool size, crash-loop state (obs/blackbox.py)
+            snap["blackbox"] = blackbox.stats()
         self._send_json(200, snap)
 
     def r_debug_slo(self):
@@ -499,7 +549,8 @@ class Handler(BaseHTTPRequestHandler):
             "1", "true", "yes",
         ):
             self._send_json(
-                200, self.api.cluster_history(series=series, step=step)
+                200, self.api.cluster_history(series=series, step=step),
+                gzip_ok=True,
             )
             return
         snap = self.api.history_query(
@@ -508,7 +559,7 @@ class Handler(BaseHTTPRequestHandler):
         if snap is None:
             self._send_json(404, {"error": "metrics history disabled"})
             return
-        self._send_json(200, snap)
+        self._send_json(200, snap, gzip_ok=True)
 
     def r_debug_events(self):
         """Event journal past ?since=<seq> (gap-free cursor resume);
@@ -542,9 +593,13 @@ class Handler(BaseHTTPRequestHandler):
             "1", "true", "yes",
         ):
             if trace_id:
-                self._send_json(200, self.api.cluster_trace(trace_id))
+                self._send_json(
+                    200, self.api.cluster_trace(trace_id), gzip_ok=True
+                )
             else:
-                self._send_json(200, self.api.cluster_traces(limit))
+                self._send_json(
+                    200, self.api.cluster_traces(limit), gzip_ok=True
+                )
             return
         if trace_id:
             if self.query_params.get("spans", ["false"])[0].lower() in (
@@ -552,15 +607,17 @@ class Handler(BaseHTTPRequestHandler):
             ):
                 # peer leg of cluster assembly: raw local spans, kept
                 # OR recent, 200 even when empty
-                self._send_json(200, self.api.trace_spans(trace_id))
+                self._send_json(
+                    200, self.api.trace_spans(trace_id), gzip_ok=True
+                )
                 return
             detail = self.api.trace_detail(trace_id)
             if detail is None:
                 self._send_json(404, {"error": f"trace {trace_id} not kept"})
             else:
-                self._send_json(200, detail)
+                self._send_json(200, detail, gzip_ok=True)
             return
-        self._send_json(200, self.api.traces_snapshot(limit))
+        self._send_json(200, self.api.traces_snapshot(limit), gzip_ok=True)
 
     def r_debug_incidents(self):
         """Flight-recorder incident bundles (alert-edge / 504-spike
@@ -576,6 +633,32 @@ class Handler(BaseHTTPRequestHandler):
                 self._send_json(200, detail)
             return
         self._send_json(200, self.api.incidents_snapshot())
+
+    def r_debug_postmortem(self):
+        """Sealed crash bundles from the black box (obs/blackbox.py):
+        bare GET returns retained summaries + the newest bundle in
+        full; ?id= one bundle; ?cluster=true merges every peer's
+        summaries at the coordinator."""
+        if self.query_params.get("cluster", ["false"])[0].lower() in (
+            "1", "true", "yes",
+        ):
+            self._send_json(
+                200, self.api.cluster_postmortems(), gzip_ok=True
+            )
+            return
+        pm_id = self.query_params.get("id", [None])[0]
+        snap = self.api.postmortem_snapshot(pm_id)
+        if snap is None:
+            if pm_id:
+                self._send_json(
+                    404, {"error": f"postmortem {pm_id} not found"}
+                )
+            else:
+                self._send_json(
+                    404, {"error": "black box disabled (no data dir)"}
+                )
+            return
+        self._send_json(200, snap, gzip_ok=True)
 
     def r_debug_devcosts(self):
         """Device cost ledger: per-site and per-(tenant, index, op_class)

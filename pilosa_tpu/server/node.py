@@ -211,6 +211,12 @@ class NodeServer:
         qos_tick_interval: float = 0.25,
         qos_retry_after: float = 1.0,
         qos_aggressor_share: float = 0.5,
+        blackbox_enabled: bool = True,
+        blackbox_interval: float = 5.0,
+        blackbox_max_segments: int = 64,
+        blackbox_max_bytes: int = 16 << 20,
+        blackbox_keep_postmortems: int = 4,
+        blackbox_history_window: float = 60.0,
     ):
         self.host = host
         # HBM budget override: device memory is process-global (one
@@ -423,6 +429,37 @@ class NodeServer:
         )
         if self.flightrec is not None:
             devledger.on_storm(self.flightrec.capture_incident)
+        # Crash-durable black box (obs/blackbox.py): a bounded on-disk
+        # spool continuously checkpointing the perishable tails of the
+        # planes above; on a dirty restart the previous life's spool is
+        # sealed into the postmortem served at /debug/postmortem.  Only
+        # meaningful with a data dir — a diskless node has nowhere to
+        # survive a crash.
+        self.blackbox = None
+        self.postmortem = None
+        if blackbox_enabled and data_dir is not None:
+            from pilosa_tpu.obs.blackbox import BlackBox
+
+            self.blackbox = BlackBox(
+                self.holder,
+                data_dir,
+                api=self.api,
+                flightrec=self.flightrec,
+                history=self.history,
+                node_id=self.node_id,
+                interval=blackbox_interval,
+                max_segments=blackbox_max_segments,
+                max_bytes=blackbox_max_bytes,
+                keep_postmortems=blackbox_keep_postmortems,
+                history_window=blackbox_history_window,
+            )
+            self.api.blackbox = self.blackbox
+            self.postmortem = self.blackbox.open()
+            if self.flightrec is not None:
+                # incident bundles reach disk the moment they freeze,
+                # not up to one writer interval later
+                self.flightrec.on_incident = self.blackbox.flush_incident
+        self._stopped = False
         self.gc_notifier = GCNotifier()
         self.runtime_monitor = RuntimeMonitor(
             self.holder.stats,
@@ -516,6 +553,8 @@ class NodeServer:
             self.history.start()
         if self.resize_watchdog is not None:
             self.resize_watchdog.start()
+        if self.blackbox is not None:
+            self.blackbox.start()
         self.holder.events.record(
             ev.EVENT_NODE_START, uri=self.uri, state=self.api.state
         )
@@ -633,9 +672,33 @@ class NodeServer:
             self.membership.start()
         return self.membership
 
+    def shutdown_graceful(self) -> None:
+        """The orderly SIGTERM path: journal ``node-stop`` (so the
+        black box's final checkpoint carries it), then run the full
+        stop — drain the batcher/QoS queues, stop the samplers, write
+        the clean-shutdown marker.  Callers (signal handler, CLI) exit
+        0 afterwards: a graceful stop must never read as a crash."""
+        if self._stopped:
+            return
+        self.holder.events.record(ev.EVENT_NODE_STOP, uri=self.uri)
+        self.stop()
+
+    def install_signal_handlers(self) -> bool:
+        """Route SIGTERM through :meth:`shutdown_graceful` for this
+        node.  Returns False off the main thread (in-process test
+        clusters manage lifecycle themselves)."""
+        from pilosa_tpu.obs import blackbox as bb
+
+        return bb.install_signal_handlers(self)
+
     def stop(self) -> None:
+        if self._stopped:
+            return  # SIGTERM handler + CLI finally may both land here
+        self._stopped = True
+        from pilosa_tpu.obs import blackbox as bb
         from pilosa_tpu.parallel import meshplace
 
+        bb.uninstall_signal_handlers(self)
         # Withdraw from the placement map FIRST: peers must stop
         # resolving our fragments before the holder starts tearing down.
         meshplace.default_placement().unregister(self.node_id)
@@ -658,3 +721,7 @@ class NodeServer:
         self.diagnostics.stop()
         self.gc_notifier.close()
         self.server.close()
+        if self.blackbox is not None:
+            # last: the final checkpoint captures the drained planes,
+            # then the clean marker seals this life as orderly
+            self.blackbox.close(clean=True)

@@ -64,6 +64,12 @@ class InProcessCluster:
         qos_tick_interval: float = 0.25,
         qos_retry_after: float = 1.0,
         qos_aggressor_share: float = 0.5,
+        blackbox_enabled: bool = True,
+        blackbox_interval: float = 5.0,
+        blackbox_max_segments: int = 64,
+        blackbox_max_bytes: int = 16 << 20,
+        blackbox_keep_postmortems: int = 4,
+        blackbox_history_window: float = 60.0,
     ):
         self._tmp = tempfile.TemporaryDirectory() if with_disk else None
         self.nodes: list[NodeServer] = []
@@ -109,6 +115,14 @@ class InProcessCluster:
             "qos_tick_interval": qos_tick_interval,
             "qos_retry_after": qos_retry_after,
             "qos_aggressor_share": qos_aggressor_share,
+            # black box only engages on with_disk clusters (a diskless
+            # node has nowhere to survive a crash)
+            "blackbox_enabled": blackbox_enabled,
+            "blackbox_interval": blackbox_interval,
+            "blackbox_max_segments": blackbox_max_segments,
+            "blackbox_max_bytes": blackbox_max_bytes,
+            "blackbox_keep_postmortems": blackbox_keep_postmortems,
+            "blackbox_history_window": blackbox_history_window,
         }
         # Monotonic so a node added after a removal never reuses a live
         # node's data dir (dirs are keyed by birth order, not list index).

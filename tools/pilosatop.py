@@ -13,6 +13,12 @@ available.  Usage::
 
     python -m tools.pilosatop --host 127.0.0.1:10101 [--interval 1.0]
         [--series 'slo.*'] [--window 120] [--cluster] [--curses]
+        [--postmortem]
+
+``--postmortem`` adds a black-box pane (``GET /debug/postmortem``, or
+the coordinator-merged ``?cluster=true`` view with ``--cluster``):
+sealed crash bundles with crash-loop counts, frozen-incident counts,
+and the dead life's last words when faulthandler got them to disk.
 
 Reads are resumable ``?since=`` pulls against the ring TSDB, so the
 dashboard costs the node one bounded slice per refresh, not a full
@@ -99,9 +105,70 @@ def _fmt(v, nd=1, unit="") -> str:
     return f"{v:.{nd}f}{unit}"
 
 
+def _age(ts) -> str:
+    if not ts:
+        return "-"
+    secs = max(0.0, time.time() - ts)
+    if secs < 90:
+        return f"{secs:.0f}s"
+    if secs < 5400:
+        return f"{secs / 60:.0f}m"
+    return f"{secs / 3600:.1f}h"
+
+
+def render_postmortems(pm: dict | None, cluster: bool, c) -> list[str]:
+    """Black-box pane lines for ``GET /debug/postmortem`` (single node)
+    or ``?cluster=true`` (coordinator merge)."""
+    lines = [c(_BOLD, "black box (postmortems)")]
+    if pm is None:
+        lines.append(c(_DIM, "  /debug/postmortem unreachable or disabled"))
+        return lines
+    summaries = pm.get("postmortems") or []
+    if not summaries:
+        lines.append(c(_GREEN, "  no crashes on record"))
+        return lines
+    lines.append(c(
+        _BOLD,
+        f"  {'id':<18} {'node':<10} {'crashed':>8} {'loop':>5} "
+        f"{'incid':>6} {'segs':>5} {'torn':>5}  last words",
+    ))
+    for s in summaries[:5]:
+        loop = s.get("crashLoop") or 0
+        row = (
+            f"  {str(s.get('id'))[:18]:<18} "
+            f"{str(s.get('node') or '-')[:10]:<10} "
+            f"{_age(s.get('lastCheckpointAt') or s.get('assembledAt')):>8} "
+            f"{loop:>5} {s.get('incidents', 0):>6} "
+            f"{s.get('segments', 0):>5} {s.get('torn', 0):>5}  "
+            f"{'yes' if s.get('lastWords') else '-'}"
+        )
+        lines.append(c(_RED, row) if loop >= 3 else row)
+    latest = pm.get("postmortem")  # full bundle (single-node view only)
+    if latest:
+        for b in (latest.get("incidents") or [])[-3:]:
+            trig = b.get("trigger") or {}
+            lines.append(c(
+                _YELLOW,
+                f"    incident {b.get('id')} "
+                f"{trig.get('type', '?')} ({_age(b.get('at'))} ago)",
+            ))
+        words = (latest.get("lastWords") or "").strip()
+        if words:
+            lines.append(c(_DIM, "    last words:"))
+            for w in words.splitlines()[:4]:
+                lines.append(c(_DIM, f"      {w[:100]}"))
+    if cluster:
+        for u in (pm.get("unreachable") or [])[:3]:
+            lines.append(
+                c(_RED, f"  unreachable: {u.get('node')} ({u.get('error')})")
+            )
+    return lines
+
+
 def render(
     snap: dict, incidents: dict | None, host: str, cluster: bool,
-    color: bool = True,
+    color: bool = True, postmortems: dict | None = None,
+    show_postmortems: bool = False,
 ) -> str:
     def c(code: str, s: str) -> str:
         return f"{code}{s}{_RESET}" if color else s
@@ -214,12 +281,15 @@ def render(
                     f"{trig.get('series')} "
                     f"({time.strftime('%H:%M:%S', time.localtime(i.get('at', 0)))})"
                 )
+    if show_postmortems:
+        lines.append("")
+        lines.extend(render_postmortems(postmortems, cluster, c))
     lines.append("")
     lines.append(c(_DIM, "q/Ctrl-C to quit"))
     return "\n".join(lines)
 
 
-def _pull(args) -> tuple[dict | None, dict | None]:
+def _pull(args) -> tuple[dict | None, dict | None, dict | None]:
     qs = [f"step={args.interval}"]
     if args.series:
         qs.append("series=" + urllib.parse.quote(args.series, safe=""))
@@ -229,12 +299,16 @@ def _pull(args) -> tuple[dict | None, dict | None]:
         qs.append(f"limit={int(args.window)}")
     snap = _fetch(args.host, "/debug/history?" + "&".join(qs))
     incidents = _fetch(args.host, "/debug/incidents")
-    return snap, incidents
+    pm = None
+    if args.postmortem:
+        pm_qs = "?cluster=true" if args.cluster else ""
+        pm = _fetch(args.host, "/debug/postmortem" + pm_qs)
+    return snap, incidents, pm
 
 
 def _loop_ansi(args) -> int:
     while True:
-        snap, incidents = _pull(args)
+        snap, incidents, pm = _pull(args)
         sys.stdout.write(_CLEAR)
         if snap is None:
             sys.stdout.write(
@@ -243,7 +317,9 @@ def _loop_ansi(args) -> int:
             )
         else:
             sys.stdout.write(
-                render(snap, incidents, args.host, args.cluster) + "\n"
+                render(snap, incidents, args.host, args.cluster,
+                       postmortems=pm, show_postmortems=args.postmortem)
+                + "\n"
             )
         sys.stdout.flush()
         time.sleep(args.interval)
@@ -256,11 +332,12 @@ def _loop_curses(args) -> int:
         curses.curs_set(0)
         scr.nodelay(True)
         while True:
-            snap, incidents = _pull(args)
+            snap, incidents, pm = _pull(args)
             scr.erase()
             text = (
                 render(snap, incidents, args.host, args.cluster,
-                       color=False)
+                       color=False, postmortems=pm,
+                       show_postmortems=args.postmortem)
                 if snap is not None
                 else f"pilosatop: {args.host} unreachable — retrying"
             )
@@ -295,16 +372,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="coordinator-merged cluster timeline")
     ap.add_argument("--curses", action="store_true",
                     help="curses renderer (default: plain ANSI redraw)")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="add the black-box pane (/debug/postmortem; "
+                         "cluster-merged with --cluster)")
     ap.add_argument("--once", action="store_true",
                     help="render one frame to stdout and exit (no ANSI)")
     args = ap.parse_args(argv)
     if args.once:
-        snap, incidents = _pull(args)
+        snap, incidents, pm = _pull(args)
         if snap is None:
             print(f"pilosatop: {args.host} unreachable or history disabled")
             return 1
         print(render(snap, incidents, args.host, args.cluster,
-                     color=False))
+                     color=False, postmortems=pm,
+                     show_postmortems=args.postmortem))
         return 0
     try:
         if args.curses:
